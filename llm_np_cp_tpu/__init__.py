@@ -1,0 +1,26 @@
+"""llm_np_cp_tpu — a TPU-native LLM inference framework.
+
+A brand-new JAX/XLA/Pallas implementation of the capability surface of the
+reference `llm_np_cp` repo (from-scratch Llama-3.2 / Gemma-2 autoregressive
+inference: HF safetensors loading, RMSNorm / RoPE / GQA attention /
+SwiGLU-GeGLU ops, KV-cached prefill+decode, greedy/min-p sampling, streaming
+generation) — re-designed TPU-first:
+
+- one jitted decode step with static shapes (no per-token Python math)
+- preallocated KV cache updated via ``lax.dynamic_update_slice`` (the
+  reference grows its cache by O(n) concatenation each token,
+  llama3.2_model.py:321-330 — untraceable under jit)
+- ``lax.scan`` over stacked layer params (O(1) compile time in depth)
+- tensor/data/sequence parallelism via ``jax.sharding.Mesh`` + NamedSharding
+  with XLA collectives over ICI (the reference has no distributed path at
+  all, SURVEY §2.9)
+- Pallas kernels for the custom-kernel role played by the reference's inline
+  CUDA softmax (llama3.2_model.py:924-975)
+"""
+
+from llm_np_cp_tpu.config import ModelConfig
+from llm_np_cp_tpu.cache import KVCache
+
+__version__ = "0.1.0"
+
+__all__ = ["ModelConfig", "KVCache", "__version__"]

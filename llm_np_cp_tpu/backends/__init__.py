@@ -1,0 +1,12 @@
+"""Array backends.
+
+The reference ships interchangeable NumPy (CPU) and CuPy (single-GPU)
+backends as twin files (SURVEY §1); this framework's primary backend is
+JAX/XLA on TPU (``llm_np_cp_tpu.models``), and ``numpy_ref`` preserves the
+NumPy path — both as the ``--backend=numpy`` runtime and as the golden
+oracle for the test suite (SURVEY §4: "the NumPy file is the oracle").
+"""
+
+from llm_np_cp_tpu.backends.numpy_ref import forward_np, NpKVCache
+
+__all__ = ["forward_np", "NpKVCache"]

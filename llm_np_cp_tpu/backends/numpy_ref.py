@@ -1,0 +1,230 @@
+"""NumPy oracle backend.
+
+A clean fp32 re-derivation of the reference semantics
+(llama3.2_model_numpy.py — the de-facto golden path, SURVEY §1) used as:
+
+1. the golden oracle for the JAX path's parity tests (SURVEY §4), and
+2. the ``--backend=numpy`` runtime of the reference-compatible CLIs.
+
+Deliberate fixes vs the reference (documented, SURVEY §7 "reference bugs to
+NOT copy"):
+- softmax is always max-stabilized (the reference's live NumPy softmax is
+  the unstable ``exp/sum``, llama3.2_model_numpy.py:915);
+- the causal mask is built from positions as q_len×kv_len, so 2-token
+  prompts and chunked prefill are masked correctly (vs the ``q_len > 2``
+  q_len×q_len tril guard, llama3.2_model.py:471-478);
+- Gemma-2 attention-logit softcapping and sliding-window layers are
+  honored when the config enables them (the reference drops both,
+  SURVEY §2.7).
+
+This file intentionally shares no code with ``models/transformer.py`` — it
+is an independent implementation (loops + numpy, dynamic shapes, concat-grown
+cache like the reference's KVCache, llama3.2_model.py:303-332) so that
+agreement between the two is meaningful evidence of correctness.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any
+
+import numpy as np
+
+from llm_np_cp_tpu.config import ModelConfig
+
+
+class NpKVCache:
+    """Reference-style append cache: per-layer lists, concat growth
+    (llama3.2_model.py:303-332)."""
+
+    def __init__(self) -> None:
+        self.key_cache: list[np.ndarray] = []
+        self.value_cache: list[np.ndarray] = []
+
+    def num_items(self) -> int:
+        if not self.key_cache:
+            return 0
+        return self.key_cache[0].shape[1]  # [B, S, K, D]
+
+    def update(
+        self, keys: np.ndarray, values: np.ndarray, layer_idx: int
+    ) -> tuple[np.ndarray, np.ndarray]:
+        if len(self.key_cache) <= layer_idx:
+            self.key_cache.append(keys)
+            self.value_cache.append(values)
+        else:
+            self.key_cache[layer_idx] = np.concatenate(
+                [self.key_cache[layer_idx], keys], axis=1
+            )
+            self.value_cache[layer_idx] = np.concatenate(
+                [self.value_cache[layer_idx], values], axis=1
+            )
+        return self.key_cache[layer_idx], self.value_cache[layer_idx]
+
+
+def _rms_norm(x: np.ndarray, w: np.ndarray, eps: float, unit_offset: bool) -> np.ndarray:
+    var = np.mean(np.square(x), axis=-1, keepdims=True)
+    normed = x / np.sqrt(var + eps)
+    weight = w + 1.0 if unit_offset else w
+    return normed * weight
+
+
+def _softmax(x: np.ndarray, axis: int = -1) -> np.ndarray:
+    x = x - np.max(x, axis=axis, keepdims=True)
+    e = np.exp(x)
+    return e / np.sum(e, axis=axis, keepdims=True)
+
+
+def _silu(x: np.ndarray) -> np.ndarray:
+    return x / (1.0 + np.exp(-x))
+
+
+def _gelu_tanh(x: np.ndarray) -> np.ndarray:
+    return 0.5 * x * (1.0 + np.tanh(math.sqrt(2.0 / math.pi) * (x + 0.044715 * x**3)))
+
+
+_ACT = {"silu": _silu, "gelu_pytorch_tanh": _gelu_tanh}
+
+
+def _inv_freq(config: ModelConfig) -> np.ndarray:
+    d = config.head_dim
+    inv_freq = 1.0 / (config.rope_theta ** (np.arange(0, d, 2, dtype=np.float64) / d))
+    if config.rope_scaling_type == "llama3":
+        factor = config.rope_scaling_factor
+        low = config.rope_scaling_low_freq_factor
+        high = config.rope_scaling_high_freq_factor
+        orig = config.rope_scaling_original_max_position
+        wavelen = 2.0 * math.pi / inv_freq
+        smooth = (orig / wavelen - low) / (high - low)
+        scaled = np.where(wavelen > orig / low, inv_freq / factor, inv_freq)
+        interp = (1.0 - smooth) / factor * inv_freq + smooth * inv_freq
+        medium = (wavelen <= orig / low) & (wavelen >= orig / high)
+        inv_freq = np.where(medium, interp, scaled)
+    return inv_freq.astype(np.float32)
+
+
+def _rope(positions: np.ndarray, config: ModelConfig) -> tuple[np.ndarray, np.ndarray]:
+    freqs = positions.astype(np.float32)[..., None] * _inv_freq(config)
+    emb = np.concatenate([freqs, freqs], axis=-1)
+    return np.cos(emb), np.sin(emb)
+
+
+def _rotate_half(x: np.ndarray) -> np.ndarray:
+    h = x.shape[-1] // 2
+    return np.concatenate([-x[..., h:], x[..., :h]], axis=-1)
+
+
+def _softcap(x: np.ndarray, cap: float) -> np.ndarray:
+    return np.tanh(x / cap) * cap
+
+
+def _layer(params: dict[str, Any], idx: int) -> dict[str, np.ndarray]:
+    # fp32 contract: per-layer weights are cast too, not just top-level ones
+    # (bf16 checkpoint params must not silently compute in bf16 here).
+    return {
+        k: np.asarray(v[idx], dtype=np.float32) for k, v in params["layers"].items()
+    }
+
+
+def forward_np(
+    params: dict[str, Any],
+    input_ids: np.ndarray,
+    config: ModelConfig,
+    cache: NpKVCache | None = None,
+) -> tuple[np.ndarray, NpKVCache | None]:
+    """fp32 forward. input_ids [B, S] → logits [B, S, V] float32."""
+    params = {
+        "embed_tokens": np.asarray(params["embed_tokens"], dtype=np.float32),
+        "layers": params["layers"],
+        "final_norm": np.asarray(params["final_norm"], dtype=np.float32),
+        **(
+            {"lm_head": np.asarray(params["lm_head"], dtype=np.float32)}
+            if "lm_head" in params
+            else {}
+        ),
+    }
+    b, s = input_ids.shape
+    offset = cache.num_items() if cache is not None else 0
+    positions = offset + np.arange(s, dtype=np.int32)[None, :]
+    positions = np.broadcast_to(positions, (b, s))
+
+    x = params["embed_tokens"][input_ids]
+    if config.scale_embeddings:
+        x = x * np.float32(math.sqrt(config.hidden_size))
+
+    cos, sin = _rope(positions, config)  # [B, S, D]
+    cos_h, sin_h = cos[:, :, None, :], sin[:, :, None, :]
+    act = _ACT[config.hidden_act]
+    nh, nk, d = config.num_attention_heads, config.num_key_value_heads, config.head_dim
+    g = nh // nk
+
+    for li in range(config.num_hidden_layers):
+        w = _layer(params, li)
+        h = _rms_norm(x, w["ln_attn_in"], config.rms_norm_eps, config.rms_norm_unit_offset)
+        q = (h @ w["q_proj"]).reshape(b, s, nh, d)
+        k = (h @ w["k_proj"]).reshape(b, s, nk, d)
+        v = (h @ w["v_proj"]).reshape(b, s, nk, d)
+        q = q * cos_h + _rotate_half(q) * sin_h
+        k = k * cos_h + _rotate_half(k) * sin_h
+
+        if cache is not None:
+            k_all, v_all = cache.update(k, v, li)
+        else:
+            k_all, v_all = k, v
+        skv = k_all.shape[1]
+        kv_pos = np.arange(skv, dtype=np.int32)
+
+        # [B, S, nk, g, d] x [B, skv, nk, d] -> [B, nk, g, S, skv]
+        qg = q.reshape(b, s, nk, g, d)
+        scores = np.einsum("bqkgd,bskd->bkgqs", qg, k_all) * config.attn_scale
+        if config.attn_logit_softcapping is not None:
+            scores = _softcap(scores, config.attn_logit_softcapping)
+        mask = kv_pos[None, None, :] <= positions[:, :, None]  # [B, S, skv]
+        if config.layer_is_sliding(li):
+            mask = mask & (positions[:, :, None] - kv_pos[None, None, :] < config.sliding_window)
+        scores = np.where(mask[:, None, None, :, :], scores, np.float32(-np.inf))
+        probs = _softmax(scores)
+        attn = np.einsum("bkgqs,bskd->bqkgd", probs, v_all).reshape(b, s, nh * d)
+        attn = attn @ w["o_proj"]
+        if config.sandwich_norms:
+            attn = _rms_norm(attn, w["ln_attn_out"], config.rms_norm_eps, config.rms_norm_unit_offset)
+        x = x + attn
+
+        h = _rms_norm(x, w["ln_mlp_in"], config.rms_norm_eps, config.rms_norm_unit_offset)
+        mlp = (act(h @ w["gate_proj"]) * (h @ w["up_proj"])) @ w["down_proj"]
+        if config.sandwich_norms:
+            mlp = _rms_norm(mlp, w["ln_mlp_out"], config.rms_norm_eps, config.rms_norm_unit_offset)
+        x = x + mlp
+
+    x = _rms_norm(x, params["final_norm"], config.rms_norm_eps, config.rms_norm_unit_offset)
+    if config.tie_word_embeddings:
+        logits = x @ params["embed_tokens"].T
+    else:
+        logits = x @ params["lm_head"]
+    if config.final_logit_softcapping is not None:
+        logits = _softcap(logits, config.final_logit_softcapping)
+    return logits.astype(np.float32), cache
+
+
+def greedy_generate_np(
+    params: dict[str, Any],
+    prompt_ids: np.ndarray,
+    config: ModelConfig,
+    max_new_tokens: int,
+    use_cache: bool = True,
+) -> list[int]:
+    """Greedy decode loop (oracle for token-level parity tests)."""
+    cache = NpKVCache() if use_cache else None
+    ids = list(np.asarray(prompt_ids).reshape(-1))
+    cur = np.asarray(prompt_ids).reshape(1, -1)
+    out: list[int] = []
+    for _ in range(max_new_tokens):
+        logits, cache = forward_np(params, cur, config, cache)
+        nxt = int(np.argmax(logits[0, -1]))
+        out.append(nxt)
+        ids.append(nxt)
+        if use_cache:
+            cur = np.array([[nxt]], dtype=np.int32)
+        else:
+            cur = np.array([ids], dtype=np.int32)
+    return out

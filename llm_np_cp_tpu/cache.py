@@ -1,0 +1,93 @@
+"""Static preallocated KV cache.
+
+The reference's ``KVCache`` (llama3.2_model.py:303-332) keeps per-layer
+Python lists and appends by ``concatenate`` — an O(seq) copy per token per
+layer with unbounded growth, and a dynamic shape XLA cannot trace.  The
+TPU-native cache is a fixed-size pytree:
+
+    k, v: [num_layers, batch, max_seq, num_kv_heads, head_dim]
+    length: int32 scalar — number of tokens written (the reference's
+        ``num_items()``, llama3.2_model.py:308-312)
+
+Updates are ``lax.dynamic_update_slice`` at the current offset: O(new
+tokens), jit-traceable, donate-able.  The leading layer axis exists so the
+model can ``lax.scan`` over layers, carrying each layer's cache slice
+through as scan xs/ys.
+
+Sequence-parallel note: the seq axis (2) is placed after batch so a
+NamedSharding of P(None, "data", "seq", "model", None) shards cache slots
+across chips for long-context decode (BASELINE config 5); head axis (3)
+shards under tensor parallelism.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+from jax import lax
+
+from llm_np_cp_tpu.config import ModelConfig
+
+
+class KVCache(NamedTuple):
+    k: jnp.ndarray  # [L, B, S_max, K, D]
+    v: jnp.ndarray  # [L, B, S_max, K, D]
+    valid: jnp.ndarray  # [B, S_max] bool — written AND not a pad token
+    length: jnp.ndarray  # int32 scalar
+
+    @classmethod
+    def init(
+        cls,
+        config: ModelConfig,
+        batch_size: int,
+        max_seq_len: int,
+        dtype: jnp.dtype = jnp.bfloat16,
+    ) -> "KVCache":
+        shape = (
+            config.num_hidden_layers,
+            batch_size,
+            max_seq_len,
+            config.num_key_value_heads,
+            config.head_dim,
+        )
+        return cls(
+            k=jnp.zeros(shape, dtype=dtype),
+            v=jnp.zeros(shape, dtype=dtype),
+            valid=jnp.zeros((batch_size, max_seq_len), dtype=jnp.bool_),
+            length=jnp.zeros((), dtype=jnp.int32),
+        )
+
+    @property
+    def max_seq_len(self) -> int:
+        return self.k.shape[2]
+
+    def positions(self) -> jnp.ndarray:
+        """Absolute position of every cache slot: [S_max]."""
+        return jnp.arange(self.max_seq_len, dtype=jnp.int32)
+
+
+def update_layer(
+    k_layer: jnp.ndarray,
+    v_layer: jnp.ndarray,
+    k_new: jnp.ndarray,
+    v_new: jnp.ndarray,
+    offset: jnp.ndarray,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Write new keys/values at ``offset`` along the seq axis.
+
+    k_layer/v_layer: [B, S_max, K, D]; k_new/v_new: [B, S_new, K, D];
+    offset: int32 scalar (tokens already in the cache).  Replaces the
+    reference's per-layer concat append (llama3.2_model.py:321-330).
+
+    Overflow contract: if ``offset + S_new > S_max`` the update start is
+    silently clamped by ``dynamic_update_slice`` (XLA semantics — no
+    data-dependent errors under jit), corrupting slot/position mapping.
+    Callers must enforce capacity host-side; ``generate`` does.
+    """
+    k_new = k_new.astype(k_layer.dtype)
+    v_new = v_new.astype(v_layer.dtype)
+    zero = jnp.zeros((), dtype=jnp.int32)
+    k_layer = lax.dynamic_update_slice(k_layer, k_new, (zero, offset, zero, zero))
+    v_layer = lax.dynamic_update_slice(v_layer, v_new, (zero, offset, zero, zero))
+    return k_layer, v_layer

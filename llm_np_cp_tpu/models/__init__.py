@@ -1,0 +1,14 @@
+"""Model family assembly (the role of SURVEY §2.6/§2.7's L3 layer).
+
+One generic decoder (`transformer.py`) covers both families; `llama.py` and
+`gemma2.py` bind family-specific config/param naming.  Params are a plain
+dict pytree with layer weights stacked on a leading axis for
+``lax.scan`` — no weight-owning classes, no global ``weights`` dict
+(the reference loads weights inside every constructor,
+llama3.2_model.py:369-377; here construction and weights are separate pure
+data).
+"""
+
+from llm_np_cp_tpu.models.transformer import forward, init_params
+
+__all__ = ["forward", "init_params"]

@@ -1,0 +1,288 @@
+"""Generic decoder-only transformer forward pass.
+
+Covers the reference's two families with one traced function:
+- Llama-3.2: pre-norm residual blocks, SwiGLU MLP, tied lm_head
+  (llama3.2_model.py:511-822)
+- Gemma-2: sandwich norms (4/layer, post-norms inside the residual,
+  gemma2_model.py:588-643), embedding scaling (:738-739), GeGLU, attention
+  and final-logit softcapping, alternating sliding/global attention —
+  including the two features the reference dropped (SURVEY §2.7).
+
+Architecture (TPU-first, not a translation):
+- params are a dict pytree; per-layer weights are stacked on a leading
+  ``[num_layers, ...]`` axis and the layer loop is ``lax.scan`` — compile
+  time is O(1) in depth and XLA double-buffers the per-layer weight fetch
+  from HBM (the reference re-dispatches Python per layer,
+  llama3.2_model.py:685-697).
+- projection weights are stored **(in, out)** so every matmul is
+  ``x @ W`` with f32 accumulation on the MXU (HF checkpoints store
+  [out, in]; the loader transposes once at load time).
+- activations keep layout [B, S, H*D] / [B, S, K, D]: sequence second,
+  head_dim last — KV-cache writes are contiguous and the lane dim is the
+  128-wide axis.
+- masks derive from positions, never from shape branches (the reference's
+  ``q_len > 2`` mask guard, llama3.2_model.py:471, is a bug we don't copy).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from llm_np_cp_tpu.cache import KVCache, update_layer
+from llm_np_cp_tpu.config import ModelConfig
+from llm_np_cp_tpu.ops.activations import ACT2FN, softcap
+from llm_np_cp_tpu.ops.attention import causal_mask, gqa_attention
+from llm_np_cp_tpu.ops.norms import rms_norm
+from llm_np_cp_tpu.ops.rope import apply_rope, rope_cos_sin
+
+Params = dict[str, Any]
+
+
+# ----------------------------------------------------------------------
+# Parameter pytree
+# ----------------------------------------------------------------------
+
+def param_shapes(config: ModelConfig) -> dict[str, Any]:
+    """Shape/dtype-free spec of the parameter pytree (stacked layers)."""
+    L = config.num_hidden_layers
+    H = config.hidden_size
+    D = config.head_dim
+    NH = config.num_attention_heads
+    NK = config.num_key_value_heads
+    I = config.intermediate_size
+    V = config.vocab_size
+    layers: dict[str, tuple[int, ...]] = {
+        "ln_attn_in": (L, H),
+        "q_proj": (L, H, NH * D),
+        "k_proj": (L, H, NK * D),
+        "v_proj": (L, H, NK * D),
+        "o_proj": (L, NH * D, H),
+        "ln_mlp_in": (L, H),
+        "gate_proj": (L, H, I),
+        "up_proj": (L, H, I),
+        "down_proj": (L, I, H),
+    }
+    if config.sandwich_norms:
+        layers["ln_attn_out"] = (L, H)
+        layers["ln_mlp_out"] = (L, H)
+    spec: dict[str, Any] = {
+        "embed_tokens": (V, H),
+        "layers": layers,
+        "final_norm": (H,),
+    }
+    if not config.tie_word_embeddings:
+        spec["lm_head"] = (H, V)
+    return spec
+
+
+def init_params(
+    rng: jax.Array, config: ModelConfig, dtype: jnp.dtype = jnp.bfloat16
+) -> Params:
+    """Random small-scale init (for tests and synthetic benchmarks)."""
+    spec = param_shapes(config)
+    leaves, treedef = jax.tree.flatten(spec, is_leaf=lambda x: isinstance(x, tuple))
+    keys = jax.random.split(rng, len(leaves))
+
+    def make(key: jax.Array, shape: tuple[int, ...]) -> jnp.ndarray:
+        if len(shape) <= 2 and shape[-1] == config.hidden_size:
+            # norm gammas: zeros under unit-offset (so 1+w == 1), ones otherwise
+            if shape == (config.num_hidden_layers, config.hidden_size) or shape == (
+                config.hidden_size,
+            ):
+                init = 0.0 if config.rms_norm_unit_offset else 1.0
+                return jnp.full(shape, init, dtype=dtype)
+        scale = 0.02
+        return (jax.random.normal(key, shape, dtype=jnp.float32) * scale).astype(dtype)
+
+    return jax.tree.unflatten(
+        treedef, [make(k, s) for k, s in zip(keys, leaves)]
+    )
+
+
+# ----------------------------------------------------------------------
+# Forward
+# ----------------------------------------------------------------------
+
+def _project(x: jnp.ndarray, w: jnp.ndarray) -> jnp.ndarray:
+    return jnp.einsum("bsh,ho->bso", x, w, preferred_element_type=jnp.float32).astype(
+        x.dtype
+    )
+
+
+def forward(
+    params: Params,
+    input_ids: jnp.ndarray,
+    config: ModelConfig,
+    cache: KVCache | None = None,
+    *,
+    positions: jnp.ndarray | None = None,
+    attn_mask: jnp.ndarray | None = None,
+    logits_last_only: bool = False,
+    return_hidden: bool = False,
+) -> tuple[jnp.ndarray, KVCache | None] | tuple[jnp.ndarray, KVCache | None, jnp.ndarray]:
+    """Run the decoder.
+
+    input_ids: [B, S] int32.
+    cache: static KVCache, or None for the reference's cache-less
+        full-recompute mode (llama3.2_model.py:874-880).
+    positions: [B, S] absolute positions; defaults to
+        ``cache.length + arange(S)`` (cache-aware positions, the reference's
+        llama3.2_model.py:651-664).
+    attn_mask: optional [B, S] bool marking valid (non-pad) input tokens.
+    logits_last_only: compute lm_head for the final position only — the
+        reference computes logits for ALL positions then samples from the
+        last (llama3.2_model.py:803, :891), an O(S·V) waste in prefill.
+
+    Returns (logits, new_cache[, hidden]) — logits [B, S, V] float32 (or
+    [B, 1, V] when logits_last_only).
+    """
+    b, s = input_ids.shape
+    compute_dtype = params["embed_tokens"].dtype
+
+    offset = cache.length if cache is not None else jnp.zeros((), jnp.int32)
+    if positions is None:
+        positions = offset + jnp.arange(s, dtype=jnp.int32)[None, :]
+        positions = jnp.broadcast_to(positions, (b, s))
+
+    x = params["embed_tokens"][input_ids].astype(compute_dtype)
+    if config.scale_embeddings:
+        # Gemma: normalizer in the *weight* dtype then cast — matches the
+        # reference's bf16 sqrt(hidden) rounding (gemma2_model.py:738-739).
+        normalizer = jnp.array(math.sqrt(config.hidden_size), dtype=compute_dtype)
+        x = x * normalizer
+
+    cos, sin = rope_cos_sin(positions, config, dtype=jnp.float32)
+
+    # Masks (shared across layers; sliding-window layers select the local
+    # variant inside the scan).
+    if cache is not None:
+        kv_positions = jnp.arange(cache.max_seq_len, dtype=jnp.int32)
+        # Persist per-slot validity so pad tokens masked out in an earlier
+        # chunk stay masked in later calls (the bitmap is the source of
+        # truth; slots never written are also False).
+        new_tokens_valid = (
+            jnp.broadcast_to(attn_mask, (b, s))
+            if attn_mask is not None
+            else jnp.ones((b, s), dtype=jnp.bool_)
+        )
+        cache_valid = lax.dynamic_update_slice(
+            cache.valid, new_tokens_valid, (jnp.zeros((), jnp.int32), offset)
+        )
+        kv_valid = cache_valid
+    else:
+        kv_positions = positions
+        cache_valid = None
+        kv_valid = (
+            jnp.broadcast_to(attn_mask, (b, s)) if attn_mask is not None else None
+        )
+    mask_global = causal_mask(positions, kv_positions, kv_valid=kv_valid)
+    if config.sliding_window is not None:
+        mask_local = causal_mask(
+            positions, kv_positions, window=config.sliding_window, kv_valid=kv_valid
+        )
+    else:
+        mask_local = mask_global
+
+    lp = params["layers"]
+    num_layers = config.num_hidden_layers
+    is_sliding = jnp.array(
+        [config.layer_is_sliding(i) for i in range(num_layers)], dtype=jnp.bool_
+    )
+    act = ACT2FN[config.hidden_act]
+
+    if cache is not None:
+        k_cache, v_cache = cache.k, cache.v
+    else:
+        # Scan still needs per-layer xs of uniform shape; use zero-size dummies.
+        k_cache = jnp.zeros((num_layers, 0), dtype=compute_dtype)
+        v_cache = jnp.zeros((num_layers, 0), dtype=compute_dtype)
+
+    def layer_step(x: jnp.ndarray, xs: tuple) -> tuple[jnp.ndarray, tuple]:
+        w, k_l, v_l, sliding = xs
+
+        # --- attention block ---
+        h = rms_norm(
+            x, w["ln_attn_in"], eps=config.rms_norm_eps,
+            unit_offset=config.rms_norm_unit_offset,
+        )
+        q = _project(h, w["q_proj"]).reshape(b, s, config.num_attention_heads, config.head_dim)
+        k = _project(h, w["k_proj"]).reshape(b, s, config.num_key_value_heads, config.head_dim)
+        v = _project(h, w["v_proj"]).reshape(b, s, config.num_key_value_heads, config.head_dim)
+        q = apply_rope(q, cos, sin)
+        k = apply_rope(k, cos, sin)
+
+        if cache is not None:
+            k_l, v_l = update_layer(k_l, v_l, k, v, offset)
+            k_att, v_att = k_l, v_l
+        else:
+            k_att, v_att = k, v
+
+        mask = jnp.where(sliding, mask_local, mask_global) if config.sliding_window else mask_global
+        attn = gqa_attention(
+            q, k_att, v_att, mask,
+            scale=config.attn_scale,
+            logit_softcap=config.attn_logit_softcapping,
+        )
+        attn = _project(attn.reshape(b, s, -1), w["o_proj"])
+        if config.sandwich_norms:
+            attn = rms_norm(
+                attn, w["ln_attn_out"], eps=config.rms_norm_eps,
+                unit_offset=config.rms_norm_unit_offset,
+            )
+        x = x + attn
+
+        # --- MLP block ---
+        h = rms_norm(
+            x, w["ln_mlp_in"], eps=config.rms_norm_eps,
+            unit_offset=config.rms_norm_unit_offset,
+        )
+        gate = act(_project(h, w["gate_proj"]))
+        up = _project(h, w["up_proj"])
+        mlp = _project(gate * up, w["down_proj"])
+        if config.sandwich_norms:
+            mlp = rms_norm(
+                mlp, w["ln_mlp_out"], eps=config.rms_norm_eps,
+                unit_offset=config.rms_norm_unit_offset,
+            )
+        x = x + mlp
+        return x, (k_l, v_l)
+
+    x, (new_k, new_v) = lax.scan(layer_step, x, (lp, k_cache, v_cache, is_sliding))
+
+    x = rms_norm(
+        x, params["final_norm"], eps=config.rms_norm_eps,
+        unit_offset=config.rms_norm_unit_offset,
+    )
+
+    if logits_last_only:
+        x_logits = x[:, -1:, :]
+    else:
+        x_logits = x
+    if config.tie_word_embeddings:
+        logits = jnp.einsum(
+            "bsh,vh->bsv", x_logits, params["embed_tokens"],
+            preferred_element_type=jnp.float32,
+        )
+    else:
+        logits = jnp.einsum(
+            "bsh,hv->bsv", x_logits, params["lm_head"],
+            preferred_element_type=jnp.float32,
+        )
+    if config.final_logit_softcapping is not None:
+        logits = softcap(logits, config.final_logit_softcapping)
+    logits = logits.astype(jnp.float32)
+
+    new_cache = None
+    if cache is not None:
+        new_cache = KVCache(
+            k=new_k, v=new_v, valid=cache_valid, length=offset + s
+        )
+
+    if return_hidden:
+        return logits, new_cache, x
+    return logits, new_cache

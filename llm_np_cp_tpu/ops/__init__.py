@@ -1,0 +1,25 @@
+"""Pure-function op library (the role of SURVEY §2.2's L1 layer).
+
+Every op is a stateless function over arrays — no weight-owning classes like
+the reference's ``Linear_np``/``LlamaRMSNorm_np`` (llama3.2_model.py:116,
+237); parameters live in a pytree and are passed in, so the whole model is
+one traceable function.
+"""
+
+from llm_np_cp_tpu.ops.norms import rms_norm
+from llm_np_cp_tpu.ops.rope import rope_cos_sin, apply_rope, rotate_half
+from llm_np_cp_tpu.ops.activations import silu, gelu_tanh, ACT2FN, softcap
+from llm_np_cp_tpu.ops.attention import gqa_attention, causal_mask
+
+__all__ = [
+    "rms_norm",
+    "rope_cos_sin",
+    "apply_rope",
+    "rotate_half",
+    "silu",
+    "gelu_tanh",
+    "softcap",
+    "ACT2FN",
+    "gqa_attention",
+    "causal_mask",
+]

@@ -1,0 +1,37 @@
+"""Activations and soft-capping.
+
+Reference: tanh-approx GELU (llama3.2_model.py:88-89), SiLU (:93-97), the
+``ACT2FN_np`` registry (:103-108), and Gemma's final-logit soft cap
+``tanh(x/c)*c`` (gemma2_model.py:867-870).
+"""
+
+from __future__ import annotations
+
+import jax.nn
+import jax.numpy as jnp
+
+
+def silu(x: jnp.ndarray) -> jnp.ndarray:
+    return x * jax.nn.sigmoid(x)
+
+
+def gelu_tanh(x: jnp.ndarray) -> jnp.ndarray:
+    """``gelu_pytorch_tanh``: 0.5x(1 + tanh(sqrt(2/pi)(x + 0.044715 x^3)))."""
+    c = 0.7978845608028654  # sqrt(2/pi)
+    return 0.5 * x * (1.0 + jnp.tanh(c * (x + 0.044715 * x * x * x)))
+
+
+def relu(x: jnp.ndarray) -> jnp.ndarray:
+    return jnp.maximum(x, 0)
+
+
+ACT2FN = {
+    "silu": silu,
+    "gelu_pytorch_tanh": gelu_tanh,
+    "relu": relu,
+}
+
+
+def softcap(x: jnp.ndarray, cap: float) -> jnp.ndarray:
+    """``tanh(x / cap) * cap`` — Gemma-2 logit/score capping."""
+    return jnp.tanh(x / cap) * cap

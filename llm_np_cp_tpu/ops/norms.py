@@ -1,0 +1,33 @@
+"""RMS normalization.
+
+Reference semantics: ``x * rsqrt(mean(x^2, -1) + eps) * weight``
+(llama3.2_model.py:237-273) with Gemma's ``(1 + w)`` parameterization
+(gemma2_model.py:334 stores ``weight + 1`` at load time; we keep the raw
+checkpoint weight and add 1 in the op so params stay checkpoint-faithful).
+
+TPU note: the reduction and rsqrt run in float32 regardless of input dtype —
+bf16 mean-of-squares loses enough mantissa to move logits; the cast pair
+fuses away in XLA.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+
+def rms_norm(
+    x: jnp.ndarray,
+    weight: jnp.ndarray,
+    *,
+    eps: float = 1e-6,
+    unit_offset: bool = False,
+) -> jnp.ndarray:
+    dtype = x.dtype
+    xf = x.astype(jnp.float32)
+    var = jnp.mean(jnp.square(xf), axis=-1, keepdims=True)
+    normed = xf * lax.rsqrt(var + eps)
+    w = weight.astype(jnp.float32)
+    if unit_offset:
+        w = w + 1.0
+    return (normed * w).astype(dtype)

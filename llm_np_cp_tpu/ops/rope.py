@@ -1,0 +1,81 @@
+"""Rotary position embeddings.
+
+Reference semantics (llama3.2_model.py:30-82): ``inv_freq = base^(-2i/d)``,
+cos/sin built by duplicating the frequency block along the last axis
+(``concat([freqs, freqs])``) and rotation applied with the half-split
+``rotate_half`` convention: ``q*cos + rotate_half(q)*sin``.
+
+Beyond the reference: llama-3 rope scaling (smooth low/high frequency
+interpolation).  The reference reads ``rope_theta`` but ignores the
+``rope_scaling`` config block entirely (SURVEY §2.2), which mis-positions
+Llama-3.1/3.2 beyond the original 8k context; we implement it and switch it
+off in reference-parity mode.
+
+TPU note: cos/sin are computed once per forward from the position vector —
+a [S, D] table, negligible next to the matmuls — so there is no precomputed
+max-length table eating HBM, and positions can be traced values (cache
+offsets) under jit.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax.numpy as jnp
+
+from llm_np_cp_tpu.config import ModelConfig
+
+
+def _inv_freq(config: ModelConfig) -> jnp.ndarray:
+    dim = config.head_dim
+    inv_freq = 1.0 / (
+        config.rope_theta ** (jnp.arange(0, dim, 2, dtype=jnp.float32) / dim)
+    )
+    if config.rope_scaling_type == "llama3":
+        # Smoothly interpolate: high-frequency (short wavelength) components
+        # unchanged, low-frequency components divided by `factor`, linear
+        # ramp between the two corner wavelengths.
+        factor = config.rope_scaling_factor
+        low = config.rope_scaling_low_freq_factor
+        high = config.rope_scaling_high_freq_factor
+        orig = config.rope_scaling_original_max_position
+        wavelen = 2.0 * math.pi / inv_freq
+        low_wavelen = orig / low
+        high_wavelen = orig / high
+        smooth = (orig / wavelen - low) / (high - low)
+        scaled = jnp.where(wavelen > low_wavelen, inv_freq / factor, inv_freq)
+        interp = (1.0 - smooth) / factor * inv_freq + smooth * inv_freq
+        is_medium = (wavelen <= low_wavelen) & (wavelen >= high_wavelen)
+        inv_freq = jnp.where(is_medium, interp, scaled)
+    return inv_freq
+
+
+def rope_cos_sin(
+    positions: jnp.ndarray, config: ModelConfig, dtype: jnp.dtype = jnp.float32
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """cos/sin tables for ``positions`` (any leading shape) → [..., head_dim]."""
+    inv_freq = _inv_freq(config)
+    freqs = positions.astype(jnp.float32)[..., None] * inv_freq  # [..., dim/2]
+    emb = jnp.concatenate([freqs, freqs], axis=-1)  # [..., dim]
+    return jnp.cos(emb).astype(dtype), jnp.sin(emb).astype(dtype)
+
+
+def rotate_half(x: jnp.ndarray) -> jnp.ndarray:
+    half = x.shape[-1] // 2
+    x1, x2 = x[..., :half], x[..., half:]
+    return jnp.concatenate([-x2, x1], axis=-1)
+
+
+def apply_rope(
+    x: jnp.ndarray, cos: jnp.ndarray, sin: jnp.ndarray
+) -> jnp.ndarray:
+    """Rotate ``x``: [..., S, n_heads, head_dim] with cos/sin [..., S, head_dim].
+
+    The head axis sits between the sequence axis and head_dim, so cos/sin
+    broadcast with one unsqueeze (the reference's ``unsqueeze_dim=1`` on a
+    [b, h, s, d] layout — llama3.2_model.py:77-82; we keep [b, s, h, d]
+    because it writes into the KV cache without a transpose).
+    """
+    cos = cos[..., None, :]
+    sin = sin[..., None, :]
+    return (x * cos + rotate_half(x) * sin).astype(x.dtype)

@@ -1,0 +1,8 @@
+"""Parallelism: device meshes, sharding specs, and collective layouts.
+
+The reference has no distributed path at all (SURVEY §2.9 — single process,
+single device); this package is the capability the TPU build adds: tensor /
+data / sequence parallelism expressed as ``jax.sharding`` NamedShardings
+over a ``Mesh``, with XLA inserting ``psum`` / ``all_gather`` /
+``ppermute`` collectives over ICI.
+"""

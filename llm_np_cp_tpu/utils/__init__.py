@@ -1,0 +1,1 @@
+"""Runtime utilities: checkpoint loading, profiling, misc."""

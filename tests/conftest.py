@@ -1,0 +1,22 @@
+"""Test env: force a virtual 8-device CPU backend.
+
+Multi-chip sharding is tested on host CPU with 8 virtual devices (the
+standard fake-backend trick, SURVEY §4e); real-TPU behavior is exercised by
+bench.py and the driver's dryrun instead.
+
+Note: this environment pre-imports jax at interpreter start and pins
+``JAX_PLATFORMS=axon`` (the real TPU tunnel), so env-var edits here are too
+late — we go through ``jax.config`` instead, before any backend initializes.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
+
+
+@pytest.fixture
+def rng_np():
+    return np.random.default_rng(0)

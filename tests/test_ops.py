@@ -1,0 +1,168 @@
+"""Per-op parity: JAX ops vs independent NumPy formulations (SURVEY §4a)."""
+
+import math
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from llm_np_cp_tpu.config import tiny_config
+from llm_np_cp_tpu.ops import (
+    apply_rope,
+    causal_mask,
+    gelu_tanh,
+    gqa_attention,
+    rms_norm,
+    rope_cos_sin,
+    silu,
+    softcap,
+)
+
+
+def test_rms_norm_matches_numpy(rng_np):
+    x = rng_np.standard_normal((2, 5, 16), dtype=np.float32) * 3
+    w = rng_np.standard_normal(16, dtype=np.float32)
+    got = np.asarray(rms_norm(jnp.asarray(x), jnp.asarray(w), eps=1e-6))
+    want = x / np.sqrt(np.mean(x**2, -1, keepdims=True) + 1e-6) * w
+    np.testing.assert_allclose(got, want, atol=1e-5)
+
+
+def test_rms_norm_unit_offset(rng_np):
+    """Gemma (1+w) parameterization: zero weight == plain rmsnorm."""
+    x = rng_np.standard_normal((1, 3, 8), dtype=np.float32)
+    w0 = np.zeros(8, dtype=np.float32)
+    got = np.asarray(rms_norm(jnp.asarray(x), jnp.asarray(w0), eps=1e-6, unit_offset=True))
+    want = x / np.sqrt(np.mean(x**2, -1, keepdims=True) + 1e-6)
+    np.testing.assert_allclose(got, want, atol=1e-5)
+
+
+def test_activations(rng_np):
+    x = rng_np.standard_normal(100, dtype=np.float32) * 4
+    np.testing.assert_allclose(
+        np.asarray(silu(jnp.asarray(x))), x / (1 + np.exp(-x)), atol=1e-5
+    )
+    want_gelu = 0.5 * x * (
+        1 + np.tanh(math.sqrt(2 / math.pi) * (x + 0.044715 * x**3))
+    )
+    np.testing.assert_allclose(np.asarray(gelu_tanh(jnp.asarray(x))), want_gelu, atol=1e-5)
+
+
+def test_softcap(rng_np):
+    x = rng_np.standard_normal(50, dtype=np.float32) * 100
+    got = np.asarray(softcap(jnp.asarray(x), 30.0))
+    np.testing.assert_allclose(got, np.tanh(x / 30.0) * 30.0, rtol=1e-5)
+    assert np.max(np.abs(got)) <= 30.0
+
+
+def test_rope_rotation_preserves_norm(rng_np):
+    cfg = tiny_config()
+    pos = jnp.arange(7)[None, :]
+    cos, sin = rope_cos_sin(pos, cfg)
+    x = jnp.asarray(rng_np.standard_normal((1, 7, 4, cfg.head_dim), dtype=np.float32))
+    rot = apply_rope(x, cos, sin)
+    # Rotations preserve the per-pair norm.
+    np.testing.assert_allclose(
+        np.linalg.norm(np.asarray(rot), axis=-1),
+        np.linalg.norm(np.asarray(x), axis=-1),
+        rtol=1e-4,
+    )
+    # Position 0 is the identity rotation.
+    np.testing.assert_allclose(np.asarray(rot[:, 0]), np.asarray(x[:, 0]), atol=1e-5)
+
+
+def test_rope_relative_shift(rng_np):
+    """Score between positions p and q depends only on p-q (RoPE's point)."""
+    cfg = tiny_config()
+    q = jnp.asarray(rng_np.standard_normal((1, 1, 1, cfg.head_dim), dtype=np.float32))
+    k = jnp.asarray(rng_np.standard_normal((1, 1, 1, cfg.head_dim), dtype=np.float32))
+
+    def score(pq, pk):
+        cq, sq_ = rope_cos_sin(jnp.array([[pq]]), cfg)
+        ck, sk_ = rope_cos_sin(jnp.array([[pk]]), cfg)
+        return float(jnp.sum(apply_rope(q, cq, sq_) * apply_rope(k, ck, sk_)))
+
+    assert score(5, 3) == pytest.approx(score(12, 10), rel=1e-4)
+
+
+def test_causal_mask_q2():
+    """Regression vs the reference's q_len>2 guard (llama3.2_model.py:471):
+    a 2-token prompt MUST be causally masked."""
+    qpos = jnp.array([[0, 1]])
+    kpos = jnp.arange(2)
+    m = np.asarray(causal_mask(qpos, kpos))
+    assert m.tolist() == [[[True, False], [True, True]]]
+
+
+def test_causal_mask_sliding_window():
+    qpos = jnp.array([[4]])
+    kpos = jnp.arange(8)
+    m = np.asarray(causal_mask(qpos, kpos, window=3))[0, 0]
+    # attends positions 2,3,4 only (q - kv < 3 and kv <= q)
+    assert m.tolist() == [False, False, True, True, True, False, False, False]
+
+
+def test_gqa_attention_equals_repeated_mha(rng_np):
+    """GQA contraction == materialized repeat_kv + plain MHA
+    (the reference's repeat_kv_np route, llama3.2_model.py:180-196)."""
+    b, sq, skv, kh, g, d = 2, 4, 6, 2, 3, 8
+    h = kh * g
+    q = rng_np.standard_normal((b, sq, h, d), dtype=np.float32)
+    k = rng_np.standard_normal((b, skv, kh, d), dtype=np.float32)
+    v = rng_np.standard_normal((b, skv, kh, d), dtype=np.float32)
+    qpos = np.broadcast_to(np.arange(skv - sq, skv)[None], (b, sq))
+    mask = causal_mask(jnp.asarray(qpos), jnp.arange(skv))
+    scale = d**-0.5
+
+    got = np.asarray(
+        gqa_attention(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v), mask, scale=scale)
+    )
+
+    # independent numpy: repeat KV across groups, per-head attention
+    k_rep = np.repeat(k, g, axis=2)  # [b, skv, h, d]
+    v_rep = np.repeat(v, g, axis=2)
+    want = np.zeros_like(got)
+    mnp = np.asarray(mask)
+    for bi in range(b):
+        for hi in range(h):
+            s = (q[bi, :, hi] @ k_rep[bi, :, hi].T) * scale
+            s = np.where(mnp[bi], s, -1e30)
+            p = np.exp(s - s.max(-1, keepdims=True))
+            p /= p.sum(-1, keepdims=True)
+            want[bi, :, hi] = p @ v_rep[bi, :, hi]
+    np.testing.assert_allclose(got, want, atol=2e-5)
+
+
+def test_gqa_attention_kv_head_repeat_order(rng_np):
+    """Query head h attends kv head h // group_size (HF repeat_kv order)."""
+    b, sq, skv, kh, g, d = 1, 1, 3, 2, 2, 4
+    q = np.zeros((b, sq, kh * g, d), dtype=np.float32)
+    k = rng_np.standard_normal((b, skv, kh, d), dtype=np.float32)
+    # distinct values per kv head
+    v = np.zeros((b, skv, kh, d), dtype=np.float32)
+    v[:, :, 0, :] = 1.0
+    v[:, :, 1, :] = 2.0
+    mask = jnp.ones((b, sq, skv), dtype=bool)
+    out = np.asarray(
+        gqa_attention(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v), mask, scale=1.0)
+    )
+    # heads 0,1 -> kv head 0 (value 1); heads 2,3 -> kv head 1 (value 2)
+    np.testing.assert_allclose(out[0, 0, 0], 1.0, atol=1e-6)
+    np.testing.assert_allclose(out[0, 0, 1], 1.0, atol=1e-6)
+    np.testing.assert_allclose(out[0, 0, 2], 2.0, atol=1e-6)
+    np.testing.assert_allclose(out[0, 0, 3], 2.0, atol=1e-6)
+
+
+def test_attention_logit_softcap_changes_scores(rng_np):
+    b, sq, skv, kh, d = 1, 2, 2, 1, 4
+    q = rng_np.standard_normal((b, sq, kh, d), dtype=np.float32) * 10
+    k = rng_np.standard_normal((b, skv, kh, d), dtype=np.float32) * 10
+    v = rng_np.standard_normal((b, skv, kh, d), dtype=np.float32)
+    qpos = jnp.array([[0, 1]])
+    mask = causal_mask(qpos, jnp.arange(skv))
+    a = np.asarray(gqa_attention(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v), mask, scale=0.5))
+    b_ = np.asarray(
+        gqa_attention(
+            jnp.asarray(q), jnp.asarray(k), jnp.asarray(v), mask, scale=0.5, logit_softcap=5.0
+        )
+    )
+    assert not np.allclose(a, b_)
