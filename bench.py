@@ -1,0 +1,88 @@
+"""Decode-throughput benchmark (BASELINE.md metric: decode tokens/sec/chip).
+
+Runs the flagship Llama-3.2-1B architecture (random bf16 weights — no
+checkpoint downloads in this environment; decode throughput is
+weight-value-independent) with the fused device-side decode loop:
+prefill seq=128, then one jitted lax.scan of decode steps, bs=1
+(BASELINE config 1 shape).
+
+Prints ONE JSON line:
+  {"metric": "decode_tokens_per_sec_per_chip", "value": N,
+   "unit": "tokens/s/chip", "vs_baseline": N/1000}
+vs_baseline is against the BASELINE.json north-star target of 1,000
+decode tokens/sec/chip (the reference publishes no numbers of its own —
+SURVEY §6).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def main() -> None:
+    from llm_np_cp_tpu.cache import KVCache
+    from llm_np_cp_tpu.config import LLAMA_3_2_1B
+    from llm_np_cp_tpu.generate import make_decode_loop_fn, make_prefill_fn
+    from llm_np_cp_tpu.models.transformer import init_params
+    from llm_np_cp_tpu.ops.sampling import Sampler
+
+    config = LLAMA_3_2_1B
+    prompt_len = 128
+    decode_tokens = 256
+    max_seq = prompt_len + decode_tokens + 8
+
+    params = init_params(jax.random.PRNGKey(0), config, dtype=jnp.bfloat16)
+    sampler = Sampler(kind="greedy")
+    prefill = make_prefill_fn(config, sampler)
+    loop = make_decode_loop_fn(config, sampler)
+
+    prompt = jnp.asarray(
+        np.random.default_rng(0).integers(0, config.vocab_size, (1, prompt_len)),
+        jnp.int32,
+    )
+    key = jax.random.PRNGKey(0)
+
+    def run():
+        cache = KVCache.init(config, 1, max_seq, dtype=jnp.bfloat16)
+        t0 = time.perf_counter()
+        tok0, cache, _ = prefill(params, prompt, cache, key)
+        tok0.block_until_ready()
+        t1 = time.perf_counter()
+        toks, cache = loop(params, tok0, cache, key, decode_tokens)
+        toks.block_until_ready()
+        t2 = time.perf_counter()
+        return t1 - t0, t2 - t1
+
+    run()  # warmup: compile both programs
+    ttfts, rates = [], []
+    for _ in range(3):
+        ttft, dec = run()
+        ttfts.append(ttft)
+        rates.append(decode_tokens / dec)
+
+    rate = float(np.median(rates))
+    result = {
+        "metric": "decode_tokens_per_sec_per_chip",
+        "value": round(rate, 1),
+        "unit": "tokens/s/chip",
+        "vs_baseline": round(rate / 1000.0, 3),
+        "detail": {
+            "model": "Llama-3.2-1B (random bf16 weights)",
+            "prompt_len": prompt_len,
+            "decode_tokens": decode_tokens,
+            "batch": 1,
+            "ttft_s_p50": round(float(np.median(ttfts)), 4),
+            "backend": jax.default_backend(),
+            "device": str(jax.devices()[0]),
+        },
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

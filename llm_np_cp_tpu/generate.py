@@ -1,0 +1,296 @@
+"""Generation: prefill + decode loops (the reference's L5 layer).
+
+The reference's ``generate`` (llama3.2_model.py:865-902) re-enters Python
+every token: re-tokenize → forward → sample → decode → print.  On a TPU —
+especially a tunneled one with ~100-300ms dispatch RTT — that loop shape is
+the bottleneck regardless of model speed.  Two TPU-native paths replace it:
+
+- **fused** (default): prefill is one jitted call; the whole decode loop is a
+  second jitted call — ``lax.scan`` over decode steps with sampling *on
+  device*, so N tokens cost one dispatch.  Used by bench.py.
+- **streaming**: a Python loop around the jitted single-token step, emitting
+  token text as produced (the reference's UX, llama3.2_model.py:899-901) —
+  one dispatch per token, with incremental detokenization instead of the
+  reference's token→text→token roundtrip (:873-883, which can re-merge
+  tokens differently).
+
+Both enforce the KV-cache capacity contract host-side (overflow is silent
+under jit — see cache.update_layer) and report the metrics BASELINE.md
+tracks: p50-able TTFT and decode tokens/sec.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Callable, Iterator
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from llm_np_cp_tpu.cache import KVCache
+from llm_np_cp_tpu.config import ModelConfig
+from llm_np_cp_tpu.models.transformer import forward
+from llm_np_cp_tpu.ops.sampling import Sampler
+
+Params = dict[str, Any]
+
+
+@dataclasses.dataclass
+class GenerateResult:
+    tokens: np.ndarray  # [B, num_generated]
+    ttft_s: float  # time to first token (prefill + first sample)
+    decode_tokens_per_s: float  # steady-state decode rate (per sequence)
+    num_generated: int
+    text: list[str] | None = None
+
+
+def _check_capacity(prompt_len: int, max_new_tokens: int, max_seq_len: int) -> None:
+    need = prompt_len + max_new_tokens
+    if need > max_seq_len:
+        raise ValueError(
+            f"prompt ({prompt_len}) + max_new_tokens ({max_new_tokens}) = "
+            f"{need} exceeds KV-cache capacity {max_seq_len}; writes past "
+            f"capacity are silently clamped under jit"
+        )
+
+
+# ----------------------------------------------------------------------
+# Jitted building blocks
+# ----------------------------------------------------------------------
+
+def make_prefill_fn(
+    config: ModelConfig, sampler: Sampler, attn_impl: str = "xla"
+) -> Callable:
+    """(params, prompt_ids, cache, key) → (first_token [B], cache, logits).
+
+    attn_impl="flash" routes prefill attention through the Pallas kernel
+    (valid here: prefill always starts from a fresh cache, offset 0).
+    """
+
+    @jax.jit
+    def prefill(params: Params, prompt_ids: jnp.ndarray, cache: KVCache, key: jax.Array):
+        logits, cache = forward(
+            params, prompt_ids, config, cache, logits_last_only=True,
+            attn_impl=attn_impl,
+        )
+        tok = sampler(key, logits[:, -1])
+        return tok, cache, logits[:, -1]
+
+    return prefill
+
+
+def make_decode_step_fn(config: ModelConfig, sampler: Sampler) -> Callable:
+    """(params, tok [B], cache, key) → (next_tok [B], cache) — one token."""
+
+    @jax.jit
+    def step(params: Params, tok: jnp.ndarray, cache: KVCache, key: jax.Array):
+        logits, cache = forward(
+            params, tok[:, None], config, cache, logits_last_only=True
+        )
+        return sampler(key, logits[:, -1]), cache
+
+    return step
+
+
+def make_decode_loop_fn(
+    config: ModelConfig, sampler: Sampler, stop_tokens: tuple[int, ...] = ()
+) -> Callable:
+    """(params, first_tok, cache, key, num_steps) → (tokens [B, steps], cache).
+
+    The fused loop: ``lax.scan`` over decode steps entirely on device.
+    ``num_steps`` is static (one compile per distinct value).  Sequences
+    that hit a stop token keep feeding it (outputs past EOS are repeats the
+    caller trims) — branchless, so the scan stays a single fused program.
+    """
+    stops = jnp.asarray(stop_tokens, dtype=jnp.int32) if stop_tokens else None
+
+    from functools import partial
+
+    @partial(jax.jit, static_argnums=(4,))
+    def decode_loop(
+        params: Params,
+        first_tok: jnp.ndarray,
+        cache: KVCache,
+        key: jax.Array,
+        num_steps: int,
+    ):
+        keys = jax.random.split(key, num_steps)
+
+        def body(carry, k):
+            tok, cache, done = carry
+            logits, cache = forward(
+                params, tok[:, None], config, cache, logits_last_only=True
+            )
+            nxt = sampler(k, logits[:, -1])
+            if stops is not None:
+                nxt = jnp.where(done, tok, nxt)
+                done = done | jnp.any(nxt[:, None] == stops[None, :], axis=-1)
+            return (nxt, cache, done), nxt
+
+        done0 = (
+            jnp.any(first_tok[:, None] == stops[None, :], axis=-1)
+            if stops is not None
+            else jnp.zeros(first_tok.shape, dtype=jnp.bool_)
+        )
+        (_, cache, _), toks = lax.scan(body, (first_tok, cache, done0), keys)
+        return jnp.moveaxis(toks, 0, 1), cache  # [B, steps]
+
+    return decode_loop
+
+
+# ----------------------------------------------------------------------
+# High-level API
+# ----------------------------------------------------------------------
+
+class Generator:
+    """Owns jitted prefill/decode programs for one (model, sampler) pair.
+
+    Compiles lazily per (batch, prompt_len, num_steps) shape; repeated calls
+    with the same shapes reuse the compiled programs (jit cache).
+    """
+
+    def __init__(
+        self,
+        params: Params,
+        config: ModelConfig,
+        *,
+        sampler: Sampler | None = None,
+        stop_tokens: tuple[int, ...] = (),
+        cache_dtype: jnp.dtype = jnp.bfloat16,
+        prefill_attn_impl: str = "xla",
+    ) -> None:
+        self.params = params
+        self.config = config
+        self.sampler = sampler or Sampler()
+        self.stop_tokens = tuple(stop_tokens)
+        self.cache_dtype = cache_dtype
+        self._prefill = make_prefill_fn(config, self.sampler, prefill_attn_impl)
+        self._step = make_decode_step_fn(config, self.sampler)
+        self._loop = make_decode_loop_fn(config, self.sampler, self.stop_tokens)
+
+    def _init_cache(self, batch: int, max_seq_len: int) -> KVCache:
+        return KVCache.init(self.config, batch, max_seq_len, dtype=self.cache_dtype)
+
+    # -- fused ---------------------------------------------------------
+    def generate(
+        self,
+        prompt_ids: np.ndarray | jnp.ndarray,
+        max_new_tokens: int,
+        *,
+        max_seq_len: int | None = None,
+        seed: int = 0,
+    ) -> GenerateResult:
+        """Fused generation: 2 device dispatches total (prefill, decode scan)."""
+        prompt_ids = jnp.asarray(prompt_ids, dtype=jnp.int32)
+        if prompt_ids.ndim == 1:
+            prompt_ids = prompt_ids[None, :]
+        b, s = prompt_ids.shape
+        max_seq_len = max_seq_len or s + max_new_tokens
+        _check_capacity(s, max_new_tokens, max_seq_len)
+
+        key = jax.random.PRNGKey(seed)
+        k_pre, k_loop = jax.random.split(key)
+        cache = self._init_cache(b, max_seq_len)
+
+        t0 = time.perf_counter()
+        tok0, cache, _ = self._prefill(self.params, prompt_ids, cache, k_pre)
+        tok0.block_until_ready()
+        t1 = time.perf_counter()
+
+        if max_new_tokens > 1:
+            rest, cache = self._loop(
+                self.params, tok0, cache, k_loop, max_new_tokens - 1
+            )
+            rest.block_until_ready()
+            t2 = time.perf_counter()
+            tokens = np.concatenate([np.asarray(tok0)[:, None], np.asarray(rest)], axis=1)
+            rate = (max_new_tokens - 1) / (t2 - t1)
+        else:
+            tokens = np.asarray(tok0)[:, None]
+            rate = float("nan")
+
+        tokens = _trim_after_stop(tokens, self.stop_tokens)
+        return GenerateResult(
+            tokens=tokens,
+            ttft_s=t1 - t0,
+            decode_tokens_per_s=rate,
+            num_generated=tokens.shape[1],
+        )
+
+    # -- streaming -----------------------------------------------------
+    def stream(
+        self,
+        prompt_ids: np.ndarray | jnp.ndarray,
+        max_new_tokens: int,
+        *,
+        max_seq_len: int | None = None,
+        seed: int = 0,
+    ) -> Iterator[int]:
+        """Yield token ids one at a time (batch size 1)."""
+        prompt_ids = jnp.asarray(prompt_ids, dtype=jnp.int32)
+        if prompt_ids.ndim == 1:
+            prompt_ids = prompt_ids[None, :]
+        if prompt_ids.shape[0] != 1:
+            raise ValueError("streaming supports batch size 1")
+        s = prompt_ids.shape[1]
+        max_seq_len = max_seq_len or s + max_new_tokens
+        _check_capacity(s, max_new_tokens, max_seq_len)
+
+        key = jax.random.PRNGKey(seed)
+        cache = self._init_cache(1, max_seq_len)
+        key, k = jax.random.split(key)
+        tok, cache, _ = self._prefill(self.params, prompt_ids, cache, k)
+        t = int(tok[0])
+        yield t
+        for _ in range(max_new_tokens - 1):
+            if t in self.stop_tokens:
+                return
+            key, k = jax.random.split(key)
+            tok, cache = self._step(self.params, tok, cache, k)
+            t = int(tok[0])
+            yield t
+
+    def stream_text(
+        self,
+        tokenizer: Any,
+        prompt: str,
+        max_new_tokens: int,
+        *,
+        seed: int = 0,
+        echo: Callable[[str], None] | None = None,
+    ) -> str:
+        """Streaming text generation with incremental detokenization.
+
+        Emits only the *delta* between successive decodes of the generated
+        ids — avoids the reference's per-step token→text→token roundtrip
+        (llama3.2_model.py:873-883) while handling multi-byte merges.
+        """
+        prompt_ids = tokenizer(prompt, return_tensors="np")["input_ids"][0]
+        ids: list[int] = []
+        emitted = ""
+        for t in self.stream(prompt_ids, max_new_tokens, seed=seed):
+            ids.append(t)
+            text = tokenizer.decode(ids, skip_special_tokens=True)
+            # hold back while the last char may still change (e.g. mid UTF-8)
+            if text.endswith("�"):
+                continue
+            delta, emitted = text[len(emitted):], text
+            if echo and delta:
+                echo(delta)
+        return emitted
+
+
+def _trim_after_stop(tokens: np.ndarray, stop_tokens: tuple[int, ...]) -> np.ndarray:
+    """Replace everything after the first stop token with that stop token
+    (fused decode keeps generating repeats past EOS by construction)."""
+    if not stop_tokens:
+        return tokens
+    out = tokens.copy()
+    for b in range(out.shape[0]):
+        hits = np.isin(out[b], stop_tokens).nonzero()[0]
+        if hits.size:
+            out[b, hits[0]:] = out[b, hits[0]]
+    return out

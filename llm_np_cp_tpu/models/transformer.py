@@ -124,6 +124,7 @@ def forward(
     attn_mask: jnp.ndarray | None = None,
     logits_last_only: bool = False,
     return_hidden: bool = False,
+    attn_impl: str = "xla",
 ) -> tuple[jnp.ndarray, KVCache | None] | tuple[jnp.ndarray, KVCache | None, jnp.ndarray]:
     """Run the decoder.
 
@@ -137,6 +138,11 @@ def forward(
     logits_last_only: compute lm_head for the final position only — the
         reference computes logits for ALL positions then samples from the
         last (llama3.2_model.py:803, :891), an O(S·V) waste in prefill.
+    attn_impl: "xla" (default) or "flash" — the Pallas blockwise kernel.
+        "flash" is valid only for self-attention over positions 0..S-1
+        (fresh-cache prefill or cache-less forward with no padding); the
+        cache is still written, but attention reads the current K/V
+        directly (identical by causality since later slots are masked).
 
     Returns (logits, new_cache[, hidden]) — logits [B, S, V] float32 (or
     [B, 1, V] when logits_last_only).
@@ -222,12 +228,32 @@ def forward(
         else:
             k_att, v_att = k, v
 
-        mask = jnp.where(sliding, mask_local, mask_global) if config.sliding_window else mask_global
-        attn = gqa_attention(
-            q, k_att, v_att, mask,
-            scale=config.attn_scale,
-            logit_softcap=config.attn_logit_softcapping,
-        )
+        if attn_impl == "flash":
+            from llm_np_cp_tpu.ops.pallas.flash_attention import flash_attention
+
+            def _flash(window):
+                return flash_attention(
+                    q, k, v,  # current K/V: self-attention over 0..S-1
+                    scale=config.attn_scale,
+                    logit_softcap=config.attn_logit_softcapping,
+                    window=window,
+                )
+
+            if config.sliding_window is not None:
+                attn = lax.cond(
+                    sliding,
+                    lambda: _flash(config.sliding_window),
+                    lambda: _flash(None),
+                )
+            else:
+                attn = _flash(None)
+        else:
+            mask = jnp.where(sliding, mask_local, mask_global) if config.sliding_window else mask_global
+            attn = gqa_attention(
+                q, k_att, v_att, mask,
+                scale=config.attn_scale,
+                logit_softcap=config.attn_logit_softcapping,
+            )
         attn = _project(attn.reshape(b, s, -1), w["o_proj"])
         if config.sandwich_norms:
             attn = rms_norm(

@@ -1,0 +1,17 @@
+"""Pallas TPU kernels — the custom-kernel path.
+
+The reference's one piece of native accelerator code is an inline CUDA C
+softmax launched through ``cp.RawKernel`` (llama3.2_model.py:924-975).
+Pallas is the TPU-native equivalent of that role: ``softmax`` reproduces the
+fused-softmax kernel, and ``flash_attention`` is the kernel that actually
+matters on TPU — blockwise online-softmax attention that never materializes
+the [Sq, Skv] score matrix in HBM.
+
+Both fall back to (or are verified against) the XLA path; kernels are
+benchmark-gated, not load-bearing for correctness (SURVEY §7 step 7).
+"""
+
+from llm_np_cp_tpu.ops.pallas.softmax import softmax
+from llm_np_cp_tpu.ops.pallas.flash_attention import flash_attention
+
+__all__ = ["softmax", "flash_attention"]

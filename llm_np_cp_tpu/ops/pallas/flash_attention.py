@@ -1,0 +1,175 @@
+"""Blockwise (flash) attention kernel for prefill.
+
+The TPU-native answer to the reference's full-score-matrix attention
+(``q@k.T`` materialized at llama3.2_model.py:467-469, then a custom CUDA
+softmax over it): online-softmax over KV blocks with running (max, sum,
+accumulator) state in VMEM — the [Sq, Skv] matrix never exists in HBM, so
+long-sequence prefill is bandwidth-bound on K/V streaming only.
+
+Supports the framework's full attention surface: GQA head grouping (each
+query head reads kv head h // group), causal masking, sliding windows
+(Gemma-2 local layers), and attention-logit softcapping.
+
+Self-attention only (Sq == Skv, positions 0..S): the prefill path.  Decode
+(q_len=1 against a long cache) stays on the XLA path where the score
+"matrix" is a vector and fusion is already optimal.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = float(jnp.finfo(jnp.float32).min)
+
+
+def _flash_kernel(
+    q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref,
+    *, scale: float, block_q: int, block_kv: int,
+    softcap: float | None, window: int | None, seq_len: int,
+):
+    i = pl.program_id(1)  # q block
+    j = pl.program_id(2)  # kv block
+    nj = pl.num_programs(2)
+
+    @pl.when(j == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    q_start = i * block_q
+    kv_start = j * block_kv
+
+    # Causal: kv block visible iff its first col <= q block's last row.
+    # Window: kv block visible iff its last col is within `window` of the
+    # q block's last row.
+    visible = kv_start <= q_start + block_q - 1
+    if window is not None:
+        visible &= (q_start - (kv_start + block_kv - 1)) < window
+
+    @pl.when(visible)
+    def _work():
+        q = q_ref[0].astype(jnp.float32)  # [block_q, D]
+        k = k_ref[0].astype(jnp.float32)  # [block_kv, D]
+        v = v_ref[0].astype(jnp.float32)
+
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale  # [block_q, block_kv]
+        if softcap is not None:
+            s = jnp.tanh(s / softcap) * softcap
+
+        rows = q_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+        cols = kv_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        mask = (cols <= rows) & (cols < seq_len)
+        if window is not None:
+            mask &= (rows - cols) < window
+        s = jnp.where(mask, s, NEG_INF)
+
+        m_prev = m_ref[:]  # [block_q, 1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m_prev - m_new)
+        l_ref[:] = l_ref[:] * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        m_ref[:] = m_new
+
+    @pl.when(j == nj - 1)
+    def _finalize():
+        # Every real row attends at least itself; padded rows (beyond
+        # seq_len) have l == 0 — guard the division, their output is
+        # sliced off by the wrapper.
+        l = jnp.where(l_ref[:] == 0.0, 1.0, l_ref[:])
+        o_ref[0] = (acc_ref[:] / l).astype(o_ref.dtype)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("scale", "logit_softcap", "window", "block_q", "block_kv", "interpret"),
+)
+def flash_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    *,
+    scale: float,
+    logit_softcap: float | None = None,
+    window: int | None = None,
+    block_q: int = 128,
+    block_kv: int = 128,
+    interpret: bool | None = None,
+) -> jnp.ndarray:
+    """Causal self-attention: q [B, S, H, D], k/v [B, S, K, D] → [B, S, H, D].
+
+    Equivalent to ``ops.attention.gqa_attention`` with a causal(+window)
+    mask over positions 0..S-1 — verified against it in tests; the XLA path
+    remains the fallback (SURVEY §7 step 7: benchmark-gated).
+
+    interpret=None auto-selects: compiled on TPU, interpreter elsewhere
+    (CPU tests exercise the same kernel logic via the interpreter).
+    """
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    b, s, h, d = q.shape
+    _, _, kh, _ = k.shape
+    g = h // kh
+    out_dtype = q.dtype
+
+    # [B, S, H, D] → [B*H, S, D]; kv → [B*K, S, D]
+    qf = q.transpose(0, 2, 1, 3).reshape(b * h, s, d)
+    kf = k.transpose(0, 2, 1, 3).reshape(b * kh, s, d)
+    vf = v.transpose(0, 2, 1, 3).reshape(b * kh, s, d)
+
+    s_pad = (-s) % max(block_q, block_kv)
+    if s_pad:
+        qf = jnp.pad(qf, ((0, 0), (0, s_pad), (0, 0)))
+        kf = jnp.pad(kf, ((0, 0), (0, s_pad), (0, 0)))
+        vf = jnp.pad(vf, ((0, 0), (0, s_pad), (0, 0)))
+    sp = s + s_pad
+
+    grid = (b * h, sp // block_q, sp // block_kv)
+
+    def q_map(bh, i, j):
+        return (bh, i, 0)
+
+    def kv_map(bh, i, j):
+        # query head bh → batch bh//h, kv head (bh%h)//g
+        return ((bh // h) * kh + (bh % h) // g, j, 0)
+
+    kernel = functools.partial(
+        _flash_kernel,
+        scale=scale,
+        block_q=block_q,
+        block_kv=block_kv,
+        softcap=logit_softcap,
+        window=window,
+        seq_len=s,
+    )
+    out = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((b * h, sp, d), out_dtype),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), q_map, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_kv, d), kv_map, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_kv, d), kv_map, memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), q_map, memory_space=pltpu.VMEM),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qf, kf, vf)
+
+    if s_pad:
+        out = out[:, :s, :]
+    return out.reshape(b, h, s, d).transpose(0, 2, 1, 3)

@@ -1,0 +1,112 @@
+"""Token sampling (the reference's L4 layer, SURVEY §2.8).
+
+Reference surface: min-p sampling (live path, llama3.2_model.py:1000-1013),
+greedy argmax (commented alternative, :895-896), and a pure-Python CDF walk
+(``sample``, :828-841).  All are reproduced here as pure JAX functions over
+a ``[..., vocab]`` logits array; the RNG is ``jax.random`` (the reference
+draws through ``torch.multinomial`` — identical distributions, different
+streams, so token-level parity tests pin greedy, SURVEY §4c).
+
+Beyond the reference: temperature, top-k, and top-p, so the framework covers
+the standard sampler set users expect.
+
+Numerics note: the reference's live sampling softmax is the *unstable*
+``exp/sum`` (``softmax2``, llama3.2_model.py:991-994).  Min-p thresholds are
+invariant to the max-shift (both p and max(p) scale by the same factor), so
+the stable softmax used here is semantically identical and never overflows.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = float(jnp.finfo(jnp.float32).min)
+
+
+def greedy(logits: jnp.ndarray) -> jnp.ndarray:
+    """Argmax over the vocab axis → int32 token ids."""
+    return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+
+def min_p_mask(logits: jnp.ndarray, p_base: float) -> jnp.ndarray:
+    """Mask logits of tokens with prob < max_prob * p_base to -inf.
+
+    Equivalent to the reference's keep/renormalize (llama3.2_model.py:
+    1004-1008): ``categorical`` over the masked logits IS sampling from the
+    renormalized kept distribution.
+    """
+    logits = logits.astype(jnp.float32)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    keep = logp >= (jnp.max(logp, axis=-1, keepdims=True) + jnp.log(p_base))
+    return jnp.where(keep, logits, NEG_INF)
+
+
+def min_p(key: jax.Array, logits: jnp.ndarray, p_base: float = 0.1) -> jnp.ndarray:
+    return jax.random.categorical(key, min_p_mask(logits, p_base), axis=-1).astype(
+        jnp.int32
+    )
+
+
+def top_k_mask(logits: jnp.ndarray, k: int) -> jnp.ndarray:
+    kth = jnp.sort(logits, axis=-1)[..., -k][..., None]
+    return jnp.where(logits >= kth, logits, NEG_INF)
+
+
+def top_p_mask(logits: jnp.ndarray, p: float) -> jnp.ndarray:
+    """Nucleus: keep the smallest prefix of the sorted distribution with
+    cumulative prob >= p (the top token always survives)."""
+    sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
+    probs = jax.nn.softmax(sorted_logits, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    # token i is kept if the cumulative mass *before* it is < p
+    keep_sorted = (cum - probs) < p
+    threshold = jnp.min(
+        jnp.where(keep_sorted, sorted_logits, jnp.inf), axis=-1, keepdims=True
+    )
+    return jnp.where(logits >= threshold, logits, NEG_INF)
+
+
+def sample_cdf(key: jax.Array, logits: jnp.ndarray) -> jnp.ndarray:
+    """Inverse-CDF draw — the vectorized form of the reference's Python
+    probability walk (``sample``, llama3.2_model.py:828-841)."""
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    cdf = jnp.cumsum(probs, axis=-1)
+    u = jax.random.uniform(key, logits.shape[:-1] + (1,), dtype=jnp.float32)
+    return jnp.sum(cdf < u, axis=-1).astype(jnp.int32)
+
+
+@dataclasses.dataclass(frozen=True)
+class Sampler:
+    """Static sampler spec; ``__call__`` is traceable and closes over no state.
+
+    kind: "greedy" | "min_p" | "cdf" | "top_k" | "top_p"
+    """
+
+    kind: str = "greedy"
+    temperature: float = 1.0
+    p_base: float = 0.1  # min-p threshold (reference default, llama3.2_model.py:1000)
+    top_k: int = 50
+    top_p: float = 0.9
+
+    def __call__(self, key: jax.Array, logits: jnp.ndarray) -> jnp.ndarray:
+        logits = logits.astype(jnp.float32)
+        if self.kind == "greedy":
+            return greedy(logits)
+        if self.temperature != 1.0:
+            logits = logits / self.temperature
+        if self.kind == "min_p":
+            return min_p(key, logits, self.p_base)
+        if self.kind == "cdf":
+            return sample_cdf(key, logits)
+        if self.kind == "top_k":
+            return jax.random.categorical(
+                key, top_k_mask(logits, self.top_k), axis=-1
+            ).astype(jnp.int32)
+        if self.kind == "top_p":
+            return jax.random.categorical(
+                key, top_p_mask(logits, self.top_p), axis=-1
+            ).astype(jnp.int32)
+        raise ValueError(f"unknown sampler kind: {self.kind}")

@@ -1,0 +1,157 @@
+"""Mesh + sharding specs: the framework's distributed backbone.
+
+The reference has zero distributed capability (SURVEY §2.9: no DP/TP/PP/SP,
+no collective backend; its only "communication layer" is DLPack interop on
+one GPU).  This module is the TPU-native replacement: a
+``jax.sharding.Mesh`` over the chip grid and NamedShardings for every
+parameter / cache / activation, compiled by XLA's GSPMD partitioner into
+``psum`` / ``all_gather`` / ``reduce_scatter`` collectives that ride ICI
+within a slice (and DCN across slices — same API, XLA picks transport).
+
+Tensor-parallel layout (Megatron-style, per BASELINE north star):
+- q/k/v/gate/up projections: column-sharded (output features) on "model"
+- o/down projections: row-sharded (input features) on "model" — XLA inserts
+  the psum for the partial sums
+- embed/lm_head: vocab-sharded on "model"; logits stay vocab-sharded until
+  sampling reduces them
+- KV cache: kv-head axis sharded on "model" when divisible (Gemma-2-2B has
+  4 KV heads — on an 8-way mesh the cache falls back to replication, the
+  SURVEY §7 "TP + GQA" hard part; shard "seq" instead for long context,
+  see parallel/ring_attention)
+- batch axis: sharded on "data" everywhere
+
+No hand-written collectives are needed for TP/DP — annotate + jit is the
+whole programming model (the "How to Scale Your Model" recipe).  Explicit
+``shard_map`` collectives appear only where GSPMD can't infer the schedule
+(ring attention).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from llm_np_cp_tpu.config import ModelConfig
+
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+SEQ_AXIS = "seq"
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshPlan:
+    """Static parallelism plan: how many ways each mesh axis is split.
+
+    data: batch sharding (DP); model: tensor parallelism (TP);
+    seq: sequence/context parallelism for the KV cache and ring attention.
+    """
+
+    data: int = 1
+    model: int = 1
+    seq: int = 1
+
+    @property
+    def num_devices(self) -> int:
+        return self.data * self.model * self.seq
+
+    def validate(self, config: ModelConfig) -> None:
+        if self.model > 1:
+            for dim, name in [
+                (config.num_attention_heads, "num_attention_heads"),
+                (config.intermediate_size, "intermediate_size"),
+                (config.vocab_size, "vocab_size"),
+            ]:
+                if dim % self.model != 0:
+                    raise ValueError(
+                        f"{name}={dim} not divisible by model={self.model}"
+                    )
+
+
+def make_mesh(plan: MeshPlan, devices: list | None = None) -> Mesh:
+    devices = devices if devices is not None else jax.devices()
+    n = plan.num_devices
+    if n > len(devices):
+        raise ValueError(f"plan needs {n} devices, have {len(devices)}")
+    grid = np.asarray(devices[:n]).reshape(plan.data, plan.seq, plan.model)
+    return Mesh(grid, (DATA_AXIS, SEQ_AXIS, MODEL_AXIS))
+
+
+def _kv_heads_shardable(config: ModelConfig, plan: MeshPlan) -> bool:
+    return plan.model > 1 and config.num_key_value_heads % plan.model == 0
+
+
+def param_specs(config: ModelConfig, plan: MeshPlan) -> dict[str, Any]:
+    """PartitionSpec pytree matching models.transformer.param_shapes.
+
+    Leading layer axis of stacked weights is never sharded (lax.scan
+    consumes it).
+    """
+    m = MODEL_AXIS if plan.model > 1 else None
+    kv = MODEL_AXIS if _kv_heads_shardable(config, plan) else None
+    layers = {
+        "ln_attn_in": P(None, None),
+        "q_proj": P(None, None, m),
+        "k_proj": P(None, None, kv),
+        "v_proj": P(None, None, kv),
+        "o_proj": P(None, m, None),
+        "ln_mlp_in": P(None, None),
+        "gate_proj": P(None, None, m),
+        "up_proj": P(None, None, m),
+        "down_proj": P(None, m, None),
+    }
+    if config.sandwich_norms:
+        layers["ln_attn_out"] = P(None, None)
+        layers["ln_mlp_out"] = P(None, None)
+    specs: dict[str, Any] = {
+        "embed_tokens": P(m, None),
+        "layers": layers,
+        "final_norm": P(None),
+    }
+    if not config.tie_word_embeddings:
+        specs["lm_head"] = P(None, m)
+    return specs
+
+
+def cache_specs(config: ModelConfig, plan: MeshPlan) -> Any:
+    """KVCache sharding: [L, B, S, K, D] — batch on data, kv-heads on model
+    (when divisible), seq on the seq axis for context parallelism."""
+    from llm_np_cp_tpu.cache import KVCache
+
+    d = DATA_AXIS if plan.data > 1 else None
+    kv = MODEL_AXIS if _kv_heads_shardable(config, plan) else None
+    s = SEQ_AXIS if plan.seq > 1 else None
+    return KVCache(
+        k=P(None, d, s, kv, None),
+        v=P(None, d, s, kv, None),
+        valid=P(d, s),
+        length=P(),
+    )
+
+
+def batch_spec(plan: MeshPlan) -> P:
+    return P(DATA_AXIS if plan.data > 1 else None, None)
+
+
+def to_shardings(mesh: Mesh, specs: Any) -> Any:
+    """PartitionSpec pytree → NamedSharding pytree."""
+    return jax.tree.map(
+        lambda s: NamedSharding(mesh, s),
+        specs,
+        is_leaf=lambda x: isinstance(x, P),
+    )
+
+
+def shard_params(params: Any, config: ModelConfig, plan: MeshPlan, mesh: Mesh) -> Any:
+    """Place an existing param pytree onto the mesh."""
+    plan.validate(config)
+    shardings = to_shardings(mesh, param_specs(config, plan))
+    return jax.tree.map(jax.device_put, params, shardings)
+
+
+def shard_cache(cache: Any, config: ModelConfig, plan: MeshPlan, mesh: Mesh) -> Any:
+    shardings = to_shardings(mesh, cache_specs(config, plan))
+    return jax.tree.map(jax.device_put, cache, shardings)

@@ -1,0 +1,121 @@
+"""Pallas kernels vs XLA reference ops (interpret mode on CPU)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from llm_np_cp_tpu.ops.attention import causal_mask, gqa_attention
+from llm_np_cp_tpu.ops.pallas.flash_attention import flash_attention
+from llm_np_cp_tpu.ops.pallas.softmax import softmax as pallas_softmax
+
+
+def test_softmax_kernel_matches_xla(rng_np):
+    x = jnp.asarray(rng_np.standard_normal((3, 5, 257), dtype=np.float32) * 10)
+    got = pallas_softmax(x, interpret=True)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(jax.nn.softmax(x, axis=-1)), atol=1e-6
+    )
+
+
+def test_softmax_kernel_large_values_stable(rng_np):
+    """The role of the reference kernel's max-scan (llama3.2_model.py:940-945):
+    no overflow at large magnitudes."""
+    x = jnp.asarray(rng_np.standard_normal((4, 64), dtype=np.float32) * 1000)
+    got = np.asarray(pallas_softmax(x, interpret=True))
+    assert np.isfinite(got).all()
+    np.testing.assert_allclose(got.sum(-1), 1.0, atol=1e-5)
+
+
+def _xla_reference(q, k, v, *, scale, window=None, softcap=None):
+    b, s = q.shape[0], q.shape[1]
+    pos = jnp.broadcast_to(jnp.arange(s)[None, :], (b, s))
+    mask = causal_mask(pos, jnp.arange(s), window=window)
+    return gqa_attention(q, k, v, mask, scale=scale, logit_softcap=softcap)
+
+
+@pytest.mark.parametrize("s,h,kh,d", [(64, 4, 2, 32), (100, 4, 4, 16), (160, 8, 2, 64)])
+def test_flash_matches_xla(rng_np, s, h, kh, d):
+    b = 2
+    q = jnp.asarray(rng_np.standard_normal((b, s, h, d), dtype=np.float32))
+    k = jnp.asarray(rng_np.standard_normal((b, s, kh, d), dtype=np.float32))
+    v = jnp.asarray(rng_np.standard_normal((b, s, kh, d), dtype=np.float32))
+    scale = d**-0.5
+    want = _xla_reference(q, k, v, scale=scale)
+    got = flash_attention(
+        q, k, v, scale=scale, block_q=32, block_kv=32, interpret=True
+    )
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5)
+
+
+def test_flash_sliding_window(rng_np):
+    b, s, h, kh, d = 1, 96, 4, 2, 16
+    q = jnp.asarray(rng_np.standard_normal((b, s, h, d), dtype=np.float32))
+    k = jnp.asarray(rng_np.standard_normal((b, s, kh, d), dtype=np.float32))
+    v = jnp.asarray(rng_np.standard_normal((b, s, kh, d), dtype=np.float32))
+    want = _xla_reference(q, k, v, scale=0.25, window=20)
+    got = flash_attention(
+        q, k, v, scale=0.25, window=20, block_q=32, block_kv=32, interpret=True
+    )
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5)
+
+
+def test_flash_softcap(rng_np):
+    b, s, h, kh, d = 1, 64, 2, 1, 16
+    q = jnp.asarray(rng_np.standard_normal((b, s, h, d), dtype=np.float32) * 3)
+    k = jnp.asarray(rng_np.standard_normal((b, s, kh, d), dtype=np.float32) * 3)
+    v = jnp.asarray(rng_np.standard_normal((b, s, kh, d), dtype=np.float32))
+    want = _xla_reference(q, k, v, scale=0.25, softcap=30.0)
+    got = flash_attention(
+        q, k, v, scale=0.25, logit_softcap=30.0, block_q=32, block_kv=32,
+        interpret=True,
+    )
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5)
+
+
+def test_flash_bf16_io(rng_np):
+    b, s, h, kh, d = 1, 64, 2, 2, 32
+    q = jnp.asarray(rng_np.standard_normal((b, s, h, d), dtype=np.float32)).astype(jnp.bfloat16)
+    k = jnp.asarray(rng_np.standard_normal((b, s, kh, d), dtype=np.float32)).astype(jnp.bfloat16)
+    v = jnp.asarray(rng_np.standard_normal((b, s, kh, d), dtype=np.float32)).astype(jnp.bfloat16)
+    want = _xla_reference(q, k, v, scale=d**-0.5)
+    got = flash_attention(q, k, v, scale=d**-0.5, block_q=32, block_kv=32, interpret=True)
+    assert got.dtype == jnp.bfloat16
+    np.testing.assert_allclose(
+        np.asarray(got, np.float32), np.asarray(want, np.float32), atol=3e-2
+    )
+
+
+def test_forward_flash_prefill_matches_xla():
+    """Full-model prefill through the flash kernel == XLA attention path
+    (both families; gemma exercises softcap + sliding/global alternation)."""
+    from llm_np_cp_tpu.config import tiny_config
+    from llm_np_cp_tpu.models.transformer import forward, init_params
+
+    for model_type in ["llama", "gemma2"]:
+        cfg = tiny_config(model_type)
+        params = init_params(jax.random.PRNGKey(0), cfg, dtype=jnp.float32)
+        ids = jnp.asarray(np.arange(1, 21, dtype=np.int32)[None, :])
+        want, _ = forward(params, ids, cfg)
+        got, _ = forward(params, ids, cfg, attn_impl="flash")
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), atol=3e-4, rtol=1e-3,
+            err_msg=model_type,
+        )
+
+
+def test_generator_flash_prefill_token_parity():
+    from llm_np_cp_tpu.config import tiny_config
+    from llm_np_cp_tpu.generate import Generator
+    from llm_np_cp_tpu.models.transformer import init_params
+    from llm_np_cp_tpu.ops.sampling import Sampler
+
+    cfg = tiny_config("llama")
+    params = init_params(jax.random.PRNGKey(1), cfg, dtype=jnp.float32)
+    prompt = np.arange(2, 12, dtype=np.int32)
+    a = Generator(params, cfg, sampler=Sampler(kind="greedy"),
+                  cache_dtype=jnp.float32).generate(prompt, 6).tokens
+    b = Generator(params, cfg, sampler=Sampler(kind="greedy"),
+                  cache_dtype=jnp.float32,
+                  prefill_attn_impl="flash").generate(prompt, 6).tokens
+    np.testing.assert_array_equal(a, b)

@@ -1,0 +1,171 @@
+"""Multi-chip sharding tests on an 8-device virtual CPU mesh (SURVEY §4e)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from llm_np_cp_tpu.cache import KVCache
+from llm_np_cp_tpu.config import tiny_config
+from llm_np_cp_tpu.generate import Generator
+from llm_np_cp_tpu.models.transformer import forward, init_params
+from llm_np_cp_tpu.ops.sampling import Sampler
+from llm_np_cp_tpu.parallel.sharding import (
+    MeshPlan,
+    batch_spec,
+    cache_specs,
+    make_mesh,
+    param_specs,
+    shard_cache,
+    shard_params,
+    to_shardings,
+)
+from llm_np_cp_tpu.train import causal_lm_loss, default_optimizer, make_train_step
+
+
+def shardable_tiny(model_type="llama"):
+    # dims divisible by model=4: heads 8, kv 4, I 128, V 256
+    return tiny_config(
+        model_type,
+        num_attention_heads=8,
+        num_key_value_heads=4,
+        head_dim=8,
+        hidden_size=64,
+    )
+
+
+def test_device_count():
+    assert jax.device_count() == 8
+
+
+@pytest.mark.parametrize("plan", [MeshPlan(data=1, model=4), MeshPlan(data=2, model=4)])
+def test_tp_forward_matches_single_device(plan):
+    cfg = shardable_tiny()
+    params = init_params(jax.random.PRNGKey(0), cfg, dtype=jnp.float32)
+    ids = jnp.asarray(np.random.default_rng(0).integers(0, 255, (2, 6)), jnp.int32)
+
+    want, _ = forward(params, ids, cfg)
+
+    mesh = make_mesh(plan)
+    p_sharded = shard_params(params, cfg, plan, mesh)
+    ids_sharded = jax.device_put(
+        ids, to_shardings(mesh, batch_spec(plan))
+    )
+    with jax.set_mesh(mesh):
+        got, _ = jax.jit(lambda p, i: forward(p, i, cfg))(p_sharded, ids_sharded)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-4, rtol=1e-3)
+
+
+def test_tp_cached_decode_matches_single_device():
+    cfg = shardable_tiny()
+    plan = MeshPlan(data=1, model=4)
+    params = init_params(jax.random.PRNGKey(1), cfg, dtype=jnp.float32)
+    prompt = jnp.asarray([[5, 9, 2, 7]], jnp.int32)
+
+    # single device
+    cache = KVCache.init(cfg, 1, 12, dtype=jnp.float32)
+    want1, cache = forward(params, prompt, cfg, cache)
+    want2, _ = forward(params, jnp.asarray([[3]], jnp.int32), cfg, cache)
+
+    # sharded: kv heads (4) divide model axis (4) → cache is TP-sharded
+    mesh = make_mesh(plan)
+    p_sh = shard_params(params, cfg, plan, mesh)
+    c_sh = shard_cache(KVCache.init(cfg, 1, 12, dtype=jnp.float32), cfg, plan, mesh)
+    with jax.set_mesh(mesh):
+        step = jax.jit(lambda p, i, c: forward(p, i, cfg, c))
+        got1, c_sh = step(p_sh, prompt, c_sh)
+        got2, _ = step(p_sh, jnp.asarray([[3]], jnp.int32), c_sh)
+    np.testing.assert_allclose(np.asarray(got1), np.asarray(want1), atol=2e-4, rtol=1e-3)
+    np.testing.assert_allclose(np.asarray(got2), np.asarray(want2), atol=2e-4, rtol=1e-3)
+
+
+def test_gemma_kv_heads_not_divisible_falls_back():
+    """Gemma-2-style KV-head count (2) < TP degree (4): cache_specs must
+    replicate the kv-head axis instead of producing an invalid sharding
+    (SURVEY §7 'TP + GQA' hard part)."""
+    cfg = tiny_config(
+        "gemma2", num_attention_heads=8, num_key_value_heads=2, head_dim=8
+    )
+    plan = MeshPlan(model=4)
+    specs = cache_specs(cfg, plan)
+    assert specs.k[3] is None  # kv-head axis replicated
+    specs_p = param_specs(cfg, plan)
+    assert specs_p["layers"]["k_proj"][2] is None  # column shard disabled
+    assert specs_p["layers"]["q_proj"][2] == "model"  # q stays sharded
+
+    params = init_params(jax.random.PRNGKey(2), cfg, dtype=jnp.float32)
+    ids = jnp.asarray([[1, 2, 3]], jnp.int32)
+    want, _ = forward(params, ids, cfg)
+    mesh = make_mesh(plan)
+    p_sh = shard_params(params, cfg, plan, mesh)
+    with jax.set_mesh(mesh):
+        got, _ = jax.jit(lambda p, i: forward(p, i, cfg))(p_sh, ids)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-4, rtol=1e-3)
+
+
+def test_tp_generation_token_parity():
+    cfg = shardable_tiny()
+    plan = MeshPlan(model=4)
+    params = init_params(jax.random.PRNGKey(3), cfg, dtype=jnp.float32)
+    prompt = np.array([3, 1, 4, 1, 5], dtype=np.int32)
+
+    gen = Generator(params, cfg, sampler=Sampler(kind="greedy"), cache_dtype=jnp.float32)
+    want = gen.generate(prompt, max_new_tokens=8).tokens
+
+    mesh = make_mesh(plan)
+    p_sh = shard_params(params, cfg, plan, mesh)
+    with jax.set_mesh(mesh):
+        gen_sh = Generator(
+            p_sh, cfg, sampler=Sampler(kind="greedy"), cache_dtype=jnp.float32
+        )
+        got = gen_sh.generate(prompt, max_new_tokens=8).tokens
+    np.testing.assert_array_equal(got, want)
+
+
+def test_train_step_sharded_runs_and_reduces_loss():
+    cfg = shardable_tiny()
+    plan = MeshPlan(data=2, model=4)
+    mesh = make_mesh(plan)
+    params = init_params(jax.random.PRNGKey(4), cfg, dtype=jnp.float32)
+    params = shard_params(params, cfg, plan, mesh)
+    opt = default_optimizer(1e-3)
+    opt_state = opt.init(params)
+    step = make_train_step(cfg, opt)
+
+    batch = jax.device_put(
+        jnp.asarray(np.random.default_rng(1).integers(0, 255, (4, 16)), jnp.int32),
+        to_shardings(mesh, batch_spec(plan)),
+    )
+    with jax.set_mesh(mesh):
+        l0 = None
+        for _ in range(5):
+            params, opt_state, loss = step(params, opt_state, batch)
+            l0 = l0 if l0 is not None else float(loss)
+        lN = float(loss)
+    assert np.isfinite(l0) and np.isfinite(lN)
+    assert lN < l0  # overfits a single batch
+
+
+def test_train_step_matches_single_device():
+    """Same batch, same init → sharded loss == single-device loss."""
+    cfg = shardable_tiny()
+    params = init_params(jax.random.PRNGKey(5), cfg, dtype=jnp.float32)
+    batch = jnp.asarray(np.random.default_rng(2).integers(0, 255, (2, 10)), jnp.int32)
+
+    want = float(causal_lm_loss(params, batch, cfg))
+
+    plan = MeshPlan(data=2, model=4)
+    mesh = make_mesh(plan)
+    p_sh = shard_params(params, cfg, plan, mesh)
+    b_sh = jax.device_put(batch, to_shardings(mesh, batch_spec(plan)))
+    with jax.set_mesh(mesh):
+        got = float(jax.jit(lambda p, b: causal_lm_loss(p, b, cfg))(p_sh, b_sh))
+    assert got == pytest.approx(want, rel=1e-5)
+
+
+def test_plan_validation():
+    cfg = tiny_config("llama")  # heads=4
+    with pytest.raises(ValueError, match="not divisible"):
+        MeshPlan(model=8).validate(cfg)
+    with pytest.raises(ValueError, match="devices"):
+        make_mesh(MeshPlan(data=4, model=4))
