@@ -1,0 +1,14 @@
+#!/usr/bin/env python
+"""Gemma-2 entrypoint (reference-compatible name, gemma2_model.py).
+
+The reference file defaults to google/gemma-2-2b on one GPU
+(gemma2_model.py:1159-1167) and silently drops attention-logit softcapping
+and sliding-window attention (SURVEY §2.7); this framework implements both.
+
+    python gemma2_model.py --backend=tpu --model google/gemma-2-2b
+"""
+
+from llm_np_cp_tpu.cli import run
+
+if __name__ == "__main__":
+    run(default_model="google/gemma-2-2b")

@@ -1,0 +1,18 @@
+#!/usr/bin/env python
+"""Llama-3.2 entrypoint (reference-compatible name, llama3.2_model.py).
+
+The reference file is a CuPy/CUDA notebook export defaulting to
+meta-llama/Llama-3.2-3B on one GPU (llama3.2_model.py:1101-1109).  This
+shim runs the same capability on the TPU-native framework:
+
+    python llama3.2_model.py --backend=tpu --model meta-llama/Llama-3.2-3B
+    python llama3.2_model.py --backend=numpy   # fp32 CPU oracle path
+
+See ``python llama3.2_model.py --help`` for samplers, mesh sharding, dtype
+and streaming options.
+"""
+
+from llm_np_cp_tpu.cli import run
+
+if __name__ == "__main__":
+    run(default_model="meta-llama/Llama-3.2-3B")

@@ -1,0 +1,26 @@
+#!/usr/bin/env python
+"""Llama-3.2 NumPy entrypoint (reference-compatible name).
+
+The reference's llama3.2_model_numpy.py is the CPU twin of the CuPy file
+and the de-facto golden path (SURVEY §1); here it is a shim that defaults
+to ``--backend=numpy`` (the fp32 oracle in
+llm_np_cp_tpu/backends/numpy_ref.py) with the 1B default model the
+reference uses (llama3.2_model_numpy.py:1050).
+"""
+
+import os
+import sys
+
+# BLAS thread pinning before any numpy work — the reference sets these at
+# the very top of the file (llama3.2_model_numpy.py:4-9); honor an existing
+# user setting.
+for _v in ("OPENBLAS_NUM_THREADS", "OMP_NUM_THREADS", "MKL_NUM_THREADS"):
+    os.environ.setdefault(_v, "16")
+
+from llm_np_cp_tpu.cli import run
+
+if __name__ == "__main__":
+    argv = sys.argv[1:]
+    if not any(a.startswith("--backend") for a in argv):
+        argv = ["--backend=numpy", *argv]
+    run(argv, default_model="meta-llama/Llama-3.2-1B")

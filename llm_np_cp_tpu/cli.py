@@ -1,0 +1,190 @@
+"""Command-line entry: the reference's ``__main__`` surface, grown up.
+
+The reference hard-codes everything (model name in ``__main__``,
+llama3.2_model.py:1101-1109; ``config.use_cache = True`` by mutation;
+no argparse anywhere — SURVEY §5 config row).  Per the BASELINE north star,
+the entrypoint scripts keep the reference's names (``llama3.2_model.py``,
+``gemma2_model.py``, ``llama3.2_model_numpy.py`` at the repo root are thin
+shims over this module) and accept ``--backend={tpu,numpy}``:
+
+- ``tpu``: the JAX path — jitted prefill + fused/streamed decode, optional
+  mesh sharding (``--mesh data,seq,model``), bf16 default.
+- ``numpy``: the fp32 NumPy oracle backend (the reference's
+  llama3.2_model_numpy.py role).
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import sys
+import time
+from pathlib import Path
+from typing import Any
+
+import numpy as np
+
+
+def build_parser(default_model: str) -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        description="TPU-native LLM inference (llm_np_cp capability surface)"
+    )
+    p.add_argument("--model", default=default_model,
+                   help="HF repo id or local checkpoint dir")
+    p.add_argument("--backend", choices=["tpu", "numpy"], default="tpu")
+    p.add_argument("--prompt", default="Once upon a time")
+    p.add_argument("--max-tokens", type=int, default=200)
+    p.add_argument("--sampler", choices=["min_p", "greedy", "cdf", "top_k", "top_p"],
+                   default="min_p")
+    p.add_argument("--p-base", type=float, default=0.1, help="min-p threshold")
+    p.add_argument("--temperature", type=float, default=1.0)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--dtype", choices=["bf16", "f32"], default="bf16")
+    p.add_argument("--mesh", default="1,1,1",
+                   help="data,seq,model parallel degrees (e.g. 1,1,8 for TP=8)")
+    p.add_argument("--max-seq-len", type=int, default=None,
+                   help="KV cache capacity (default: prompt + max tokens)")
+    p.add_argument("--no-cache", action="store_true",
+                   help="cache-less full-recompute mode (reference parity)")
+    p.add_argument("--no-stream", action="store_true",
+                   help="fused decode (fastest) instead of token streaming")
+    p.add_argument("--flash-prefill", action="store_true",
+                   help="use the Pallas flash-attention kernel for prefill")
+    p.add_argument("--metrics", action="store_true",
+                   help="print tokens/sec and TTFT after generation")
+    return p
+
+
+def run(argv: list[str] | None = None, default_model: str = "meta-llama/Llama-3.2-1B") -> str:
+    args = build_parser(default_model).parse_args(argv)
+    if args.backend == "numpy":
+        return _run_numpy(args)
+    return _run_tpu(args)
+
+
+def _load(args) -> tuple[Any, Any, Any]:
+    import jax.numpy as jnp
+
+    from llm_np_cp_tpu.utils.loading import load_model
+
+    dtype = jnp.bfloat16 if args.dtype == "bf16" else jnp.float32
+    return load_model(args.model, dtype=dtype)
+
+
+def _run_numpy(args) -> str:
+    """The reference's NumPy path: fp32 oracle forward, Python decode loop."""
+    import jax
+
+    from llm_np_cp_tpu.backends.numpy_ref import NpKVCache, forward_np
+
+    tok, params, config = _load(args)
+    params_np = jax.tree.map(lambda x: np.asarray(x, np.float32), params)
+    rng = np.random.default_rng(args.seed)
+
+    ids = tok(args.prompt, return_tensors="np")["input_ids"].astype(np.int32)
+    prompt_len = ids.shape[1]
+    cache = None if args.no_cache else NpKVCache()
+    all_ids = list(ids[0])
+    emitted = ""
+    t0 = time.perf_counter()
+    ttft = None
+    for i in range(args.max_tokens):
+        logits, cache = forward_np(params_np, ids, config, cache)
+        nxt = _sample_np(logits[0, -1], args, rng)
+        if ttft is None:
+            ttft = time.perf_counter() - t0
+        all_ids.append(nxt)
+        text = tok.decode(all_ids[prompt_len:], skip_special_tokens=True)
+        if not text.endswith("�"):
+            delta, emitted = text[len(emitted):], text
+            print(delta, end="", flush=True)
+        if nxt == getattr(tok, "eos_token_id", None):
+            break
+        if args.no_cache:
+            ids = np.asarray([all_ids], dtype=np.int32)
+        else:
+            ids = np.asarray([[nxt]], dtype=np.int32)
+    print()
+    if args.metrics:
+        dt = time.perf_counter() - t0
+        n = len(all_ids) - (len(all_ids) - args.max_tokens)
+        print(f"[numpy] {n} tokens in {dt:.2f}s "
+              f"({n / dt:.2f} tok/s, ttft {ttft:.2f}s)", file=sys.stderr)
+    return emitted
+
+
+def _sample_np(logits: np.ndarray, args, rng: np.random.Generator) -> int:
+    """NumPy samplers mirroring ops.sampling semantics."""
+    logits = logits.astype(np.float64)
+    if args.sampler == "greedy":
+        return int(np.argmax(logits))
+    logits = logits / args.temperature
+    p = np.exp(logits - logits.max())
+    p /= p.sum()
+    if args.sampler == "min_p":
+        keep = p >= p.max() * args.p_base
+        p = np.where(keep, p, 0.0)
+        p /= p.sum()
+    return int(rng.choice(len(p), p=p))
+
+
+def _run_tpu(args) -> str:
+    import jax
+    import jax.numpy as jnp
+
+    from llm_np_cp_tpu.generate import Generator
+    from llm_np_cp_tpu.ops.sampling import Sampler
+    from llm_np_cp_tpu.parallel.sharding import MeshPlan, make_mesh, shard_params
+
+    tok, params, config = _load(args)
+
+    data, seq, model = (int(x) for x in args.mesh.split(","))
+    plan = MeshPlan(data=data, seq=seq, model=model)
+    mesh = None
+    if plan.num_devices > 1:
+        plan.validate(config)
+        mesh = make_mesh(plan)
+        params = shard_params(params, config, plan, mesh)
+
+    sampler = Sampler(
+        kind=args.sampler, temperature=args.temperature, p_base=args.p_base
+    )
+    eos = getattr(tok, "eos_token_id", None)
+    gen = Generator(
+        params, config,
+        sampler=sampler,
+        stop_tokens=(eos,) if eos is not None else (),
+        cache_dtype=jnp.bfloat16 if args.dtype == "bf16" else jnp.float32,
+        prefill_attn_impl="flash" if args.flash_prefill else "xla",
+    )
+
+    import contextlib
+
+    ctx = jax.set_mesh(mesh) if mesh is not None else contextlib.nullcontext()
+    with ctx:
+        if args.no_stream:
+            prompt_ids = tok(args.prompt, return_tensors="np")["input_ids"][0]
+            res = gen.generate(
+                prompt_ids, args.max_tokens,
+                max_seq_len=args.max_seq_len, seed=args.seed,
+            )
+            text = tok.decode(res.tokens[0], skip_special_tokens=True)
+            print(text)
+            if args.metrics:
+                print(
+                    f"[tpu] {res.num_generated} tokens, ttft {res.ttft_s:.3f}s, "
+                    f"{res.decode_tokens_per_s:.1f} tok/s decode",
+                    file=sys.stderr,
+                )
+            return text
+        t0 = time.perf_counter()
+        text = gen.stream_text(
+            tok, args.prompt, args.max_tokens, seed=args.seed,
+            echo=lambda s: print(s, end="", flush=True),
+        )
+        print()
+        if args.metrics:
+            dt = time.perf_counter() - t0
+            print(f"[tpu] streamed {args.max_tokens} tokens in {dt:.2f}s",
+                  file=sys.stderr)
+        return text

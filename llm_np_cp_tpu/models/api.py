@@ -1,0 +1,85 @@
+"""Reference-shaped model API.
+
+The reference's model objects are called as functions returning the 5-tuple
+``(loss, logits, kv_cache, hidden_states, attentions)``
+(llama3.2_model.py:816-822) with HF-style accessor methods
+(:744-766).  ``CausalLM`` reproduces that calling convention on top of the
+functional core — a migration surface for reference users; new code should
+call ``models.transformer.forward`` directly.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax.numpy as jnp
+
+from llm_np_cp_tpu.cache import KVCache
+from llm_np_cp_tpu.config import ModelConfig
+from llm_np_cp_tpu.models.transformer import forward
+
+
+class CausalLM:
+    """Callable model facade over (params, config)."""
+
+    def __init__(self, params: dict[str, Any], config: ModelConfig) -> None:
+        self.params = params
+        self.config = config
+
+    def __call__(
+        self,
+        input_ids: jnp.ndarray,
+        use_cache: bool = False,
+        kv_cache: KVCache | None = None,
+        labels: jnp.ndarray | None = None,
+        output_hidden_states: bool = False,
+        output_attentions: bool = False,
+    ):
+        """Returns ``(loss, logits, kv_cache, hidden_states, attentions)``.
+
+        loss is None unless ``labels`` is given (the reference's loss slot
+        is ALWAYS None, llama3.2_model.py:809 — we fill it when asked).
+        """
+        cache = kv_cache if use_cache else None
+        out = forward(
+            self.params,
+            input_ids,
+            self.config,
+            cache,
+            output_hidden_states=output_hidden_states,
+            output_attentions=output_attentions,
+        )
+        logits, new_cache = out[0], out[1]
+        aux = out[2] if len(out) > 2 else {}
+        loss = None
+        if labels is not None:
+            # HF convention: labels align with input_ids, shift happens here;
+            # positions labeled -100 are ignored.
+            import jax
+
+            logp = jax.nn.log_softmax(logits[:, :-1].astype(jnp.float32), axis=-1)
+            tgt = labels[:, 1:]
+            nll = -jnp.take_along_axis(
+                logp, jnp.maximum(tgt, 0)[..., None], axis=-1
+            )[..., 0]
+            mask = (tgt != -100).astype(jnp.float32)
+            loss = jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+        return (
+            loss,
+            logits,
+            new_cache,
+            aux.get("hidden_states"),
+            aux.get("attentions"),
+        )
+
+    # HF-style accessors (reference parity, llama3.2_model.py:744-766)
+    def get_input_embeddings(self) -> jnp.ndarray:
+        return self.params["embed_tokens"]
+
+    def set_input_embeddings(self, value: jnp.ndarray) -> None:
+        self.params["embed_tokens"] = value
+
+    def get_output_embeddings(self) -> jnp.ndarray:
+        if self.config.tie_word_embeddings:
+            return self.params["embed_tokens"]
+        return self.params["lm_head"]

@@ -123,9 +123,10 @@ def forward(
     positions: jnp.ndarray | None = None,
     attn_mask: jnp.ndarray | None = None,
     logits_last_only: bool = False,
-    return_hidden: bool = False,
+    output_hidden_states: bool = False,
+    output_attentions: bool = False,
     attn_impl: str = "xla",
-) -> tuple[jnp.ndarray, KVCache | None] | tuple[jnp.ndarray, KVCache | None, jnp.ndarray]:
+) -> tuple:
     """Run the decoder.
 
     input_ids: [B, S] int32.
@@ -138,15 +139,24 @@ def forward(
     logits_last_only: compute lm_head for the final position only — the
         reference computes logits for ALL positions then samples from the
         last (llama3.2_model.py:803, :891), an O(S·V) waste in prefill.
+    output_hidden_states / output_attentions: collect per-layer inputs
+        ([L, B, S, H]) / attention probabilities ([L, B, H, Sq, Skv]) as
+        scan outputs.  The reference accumulates these tuples on EVERY
+        forward (llama3.2_model.py:623-624, 679-706) — a memory tax; here
+        they are opt-in (SURVEY §2.6 quirks).  output_attentions requires
+        the XLA attention path (the flash kernel never materializes them).
     attn_impl: "xla" (default) or "flash" — the Pallas blockwise kernel.
         "flash" is valid only for self-attention over positions 0..S-1
         (fresh-cache prefill or cache-less forward with no padding); the
         cache is still written, but attention reads the current K/V
         directly (identical by causality since later slots are masked).
 
-    Returns (logits, new_cache[, hidden]) — logits [B, S, V] float32 (or
-    [B, 1, V] when logits_last_only).
+    Returns (logits, new_cache) — logits [B, S, V] float32 (or [B, 1, V]
+    when logits_last_only) — plus an aux dict with "hidden_states" /
+    "attentions" when either output flag is set.
     """
+    if output_attentions and attn_impl != "xla":
+        raise ValueError("output_attentions requires attn_impl='xla'")
     b, s = input_ids.shape
     compute_dtype = params["embed_tokens"].dtype
 
@@ -210,6 +220,7 @@ def forward(
 
     def layer_step(x: jnp.ndarray, xs: tuple) -> tuple[jnp.ndarray, tuple]:
         w, k_l, v_l, sliding = xs
+        x_in = x  # layer input (collected when output_hidden_states)
 
         # --- attention block ---
         h = rms_norm(
@@ -228,6 +239,7 @@ def forward(
         else:
             k_att, v_att = k, v
 
+        attn_weights = None
         if attn_impl == "flash":
             from llm_np_cp_tpu.ops.pallas.flash_attention import flash_attention
 
@@ -253,7 +265,10 @@ def forward(
                 q, k_att, v_att, mask,
                 scale=config.attn_scale,
                 logit_softcap=config.attn_logit_softcapping,
+                return_weights=output_attentions,
             )
+            if output_attentions:
+                attn, attn_weights = attn
         attn = _project(attn.reshape(b, s, -1), w["o_proj"])
         if config.sandwich_norms:
             attn = rms_norm(
@@ -276,9 +291,23 @@ def forward(
                 unit_offset=config.rms_norm_unit_offset,
             )
         x = x + mlp
-        return x, (k_l, v_l)
 
-    x, (new_k, new_v) = lax.scan(layer_step, x, (lp, k_cache, v_cache, is_sliding))
+        ys: tuple = (k_l, v_l)
+        if output_hidden_states:
+            ys += (x_in,)
+        if output_attentions:
+            ys += (attn_weights,)
+        return x, ys
+
+    x, scan_out = lax.scan(layer_step, x, (lp, k_cache, v_cache, is_sliding))
+    new_k, new_v = scan_out[0], scan_out[1]
+    aux: dict[str, jnp.ndarray] = {}
+    pos_idx = 2
+    if output_hidden_states:
+        aux["hidden_states"] = scan_out[pos_idx]  # [L, B, S, H] layer inputs
+        pos_idx += 1
+    if output_attentions:
+        aux["attentions"] = scan_out[pos_idx]  # [L, B, H, Sq, Skv]
 
     x = rms_norm(
         x, params["final_norm"], eps=config.rms_norm_eps,
@@ -309,6 +338,10 @@ def forward(
             k=new_k, v=new_v, valid=cache_valid, length=offset + s
         )
 
-    if return_hidden:
-        return logits, new_cache, x
+    if output_hidden_states:
+        # final normed output appended (reference collects it after the
+        # final norm too, llama3.2_model.py:708-713)
+        aux["final_hidden_state"] = x
+    if aux:
+        return logits, new_cache, aux
     return logits, new_cache

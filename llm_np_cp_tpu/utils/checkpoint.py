@@ -1,0 +1,44 @@
+"""Checkpoint save / resume (SURVEY §5 checkpoint row).
+
+The reference is load-only: it reads HF safetensors but can never write
+state (no training, no optimizer — SURVEY §5: "No saving, no training").
+The framework adds the missing half via Orbax: save/restore of the param
+pytree plus optimizer state and step counter, sharding-aware (restores
+directly onto a mesh when target shardings are provided), so multi-chip
+training runs can stop and resume.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+from typing import Any
+
+import jax
+import orbax.checkpoint as ocp
+
+
+def save_checkpoint(path: str | Path, state: dict[str, Any]) -> None:
+    """Write ``state`` (arbitrary pytree: params / opt_state / step)."""
+    path = Path(path).absolute()
+    ckptr = ocp.StandardCheckpointer()
+    ckptr.save(path, state, force=True)
+    ckptr.wait_until_finished()
+
+
+def restore_checkpoint(
+    path: str | Path, like: dict[str, Any] | None = None
+) -> dict[str, Any]:
+    """Restore a pytree.  ``like``: abstract target (e.g. the current state
+    pytree, or ``jax.tree.map(ocp.utils.to_shape_dtype_struct, state)``)
+    carrying dtype/sharding so arrays restore directly onto the mesh."""
+    path = Path(path).absolute()
+    ckptr = ocp.StandardCheckpointer()
+    if like is not None:
+        abstract = jax.tree.map(
+            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=getattr(x, "sharding", None))
+            if hasattr(x, "shape")
+            else x,
+            like,
+        )
+        return ckptr.restore(path, abstract)
+    return ckptr.restore(path)

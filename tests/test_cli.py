@@ -1,0 +1,83 @@
+"""CLI shim tests: reference-compatible entrypoints over both backends."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import llm_np_cp_tpu.cli as cli
+from llm_np_cp_tpu.config import tiny_config
+from llm_np_cp_tpu.models.transformer import init_params
+
+
+class FakeTokenizer:
+    eos_token_id = 199
+
+    def __call__(self, text, return_tensors=None):
+        ids = [(ord(c) % 250) + 1 for c in text][:8]
+        return {"input_ids": np.asarray([ids], dtype=np.int32)}
+
+    def decode(self, ids, skip_special_tokens=True):
+        return "".join(chr(97 + (int(i) % 26)) for i in ids)
+
+
+@pytest.fixture
+def fake_load(monkeypatch):
+    cfg = tiny_config("llama")
+    params = init_params(jax.random.PRNGKey(0), cfg, dtype=jnp.float32)
+
+    def _load(args):
+        return FakeTokenizer(), params, cfg
+
+    monkeypatch.setattr(cli, "_load", _load)
+    return cfg
+
+
+def test_cli_tpu_streaming(fake_load, capsys):
+    text = cli.run(["--backend=tpu", "--sampler=greedy", "--max-tokens=5",
+                    "--dtype=f32", "--prompt=hello"])
+    out = capsys.readouterr().out
+    assert text  # generated something
+    assert text in out  # streamed to stdout
+
+
+def test_cli_tpu_fused_matches_streamed(fake_load, capsys):
+    a = cli.run(["--backend=tpu", "--sampler=greedy", "--max-tokens=5",
+                 "--dtype=f32", "--no-stream", "--prompt=hello"])
+    b = cli.run(["--backend=tpu", "--sampler=greedy", "--max-tokens=5",
+                 "--dtype=f32", "--prompt=hello"])
+    assert a == b
+
+
+def test_cli_numpy_backend_matches_tpu_greedy(fake_load, capsys):
+    a = cli.run(["--backend=numpy", "--sampler=greedy", "--max-tokens=5",
+                 "--prompt=hello"])
+    b = cli.run(["--backend=tpu", "--sampler=greedy", "--max-tokens=5",
+                 "--dtype=f32", "--prompt=hello"])
+    assert a == b
+
+
+def test_cli_numpy_no_cache_mode(fake_load, capsys):
+    """The reference's cache-less full-recompute mode stays available."""
+    a = cli.run(["--backend=numpy", "--sampler=greedy", "--max-tokens=4",
+                 "--no-cache", "--prompt=hello"])
+    b = cli.run(["--backend=numpy", "--sampler=greedy", "--max-tokens=4",
+                 "--prompt=hello"])
+    assert a == b
+
+
+def test_cli_metrics_flag(fake_load, capsys):
+    cli.run(["--backend=tpu", "--sampler=greedy", "--max-tokens=3",
+             "--dtype=f32", "--no-stream", "--metrics"])
+    err = capsys.readouterr().err
+    assert "tok/s" in err
+
+
+def test_cli_mesh_sharded(fake_load, capsys):
+    """--mesh 1,1,2 runs TP=2 over the virtual CPU devices."""
+    cfg = fake_load
+    a = cli.run(["--backend=tpu", "--sampler=greedy", "--max-tokens=4",
+                 "--dtype=f32", "--no-stream", "--mesh=1,1,2"])
+    b = cli.run(["--backend=tpu", "--sampler=greedy", "--max-tokens=4",
+                 "--dtype=f32", "--no-stream"])
+    assert a == b

@@ -1,0 +1,77 @@
+"""Reference-shaped API surface: 5-tuple call, aux outputs (SURVEY §1 L5→L3)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from llm_np_cp_tpu.cache import KVCache
+from llm_np_cp_tpu.config import tiny_config
+from llm_np_cp_tpu.models.api import CausalLM
+from llm_np_cp_tpu.models.transformer import forward, init_params
+
+
+def _model(model_type="llama", seed=0):
+    cfg = tiny_config(model_type)
+    params = init_params(jax.random.PRNGKey(seed), cfg, dtype=jnp.float32)
+    return cfg, params
+
+
+def test_five_tuple_shape():
+    cfg, params = _model()
+    m = CausalLM(params, cfg)
+    ids = jnp.array([[1, 2, 3, 4]], dtype=jnp.int32)
+    loss, logits, cache, hidden, attn = m(ids)
+    assert loss is None  # reference behavior without labels
+    assert logits.shape == (1, 4, cfg.vocab_size)
+    assert cache is None and hidden is None and attn is None
+
+
+def test_five_tuple_with_cache_and_outputs():
+    cfg, params = _model()
+    m = CausalLM(params, cfg)
+    ids = jnp.array([[1, 2, 3, 4]], dtype=jnp.int32)
+    kv = KVCache.init(cfg, 1, 8, dtype=jnp.float32)
+    loss, logits, cache, hidden, attn = m(
+        ids, use_cache=True, kv_cache=kv,
+        output_hidden_states=True, output_attentions=True,
+    )
+    assert int(cache.length) == 4
+    L, H = cfg.num_hidden_layers, cfg.num_attention_heads
+    assert hidden.shape == (L, 1, 4, cfg.hidden_size)
+    assert attn.shape == (L, 1, H, 4, 8)  # kv axis = cache capacity
+    # attention rows over valid slots sum to 1
+    np.testing.assert_allclose(np.asarray(attn).sum(-1), 1.0, atol=1e-5)
+
+
+def test_loss_when_labels_given():
+    cfg, params = _model()
+    m = CausalLM(params, cfg)
+    ids = jnp.array([[1, 2, 3, 4, 5]], dtype=jnp.int32)
+    loss, *_ = m(ids, labels=ids)
+    assert loss is not None and np.isfinite(float(loss))
+    # ignore-index masks positions out
+    labels2 = ids.at[:, -1].set(-100)
+    loss2, *_ = m(ids, labels=labels2)
+    assert float(loss2) != float(loss)
+
+
+def test_hidden_states_first_layer_is_embedding():
+    cfg, params = _model()
+    ids = jnp.array([[7, 8]], dtype=jnp.int32)
+    _, _, aux = forward(params, ids, cfg, output_hidden_states=True)
+    want = np.asarray(params["embed_tokens"])[np.asarray(ids)]
+    np.testing.assert_allclose(
+        np.asarray(aux["hidden_states"][0]), want, atol=1e-6
+    )
+    assert aux["final_hidden_state"].shape == (1, 2, cfg.hidden_size)
+
+
+def test_output_attentions_rejects_flash():
+    cfg, params = _model()
+    ids = jnp.array([[1, 2]], dtype=jnp.int32)
+    try:
+        forward(params, ids, cfg, output_attentions=True, attn_impl="flash")
+        raised = False
+    except ValueError:
+        raised = True
+    assert raised
