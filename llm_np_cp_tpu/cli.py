@@ -16,10 +16,8 @@ shims over this module) and accept ``--backend={tpu,numpy}``:
 from __future__ import annotations
 
 import argparse
-import dataclasses
 import sys
 import time
-from pathlib import Path
 from typing import Any
 
 import numpy as np
@@ -104,17 +102,22 @@ def _run_numpy(args) -> str:
             ids = np.asarray([all_ids], dtype=np.int32)
         else:
             ids = np.asarray([[nxt]], dtype=np.int32)
+    # final flush: emit any delta held back by the mid-multibyte guard
+    text = tok.decode(all_ids[prompt_len:], skip_special_tokens=True)
+    if text != emitted:
+        print(text[len(emitted):], end="", flush=True)
+        emitted = text
     print()
     if args.metrics:
         dt = time.perf_counter() - t0
-        n = len(all_ids) - (len(all_ids) - args.max_tokens)
+        n = len(all_ids) - prompt_len
         print(f"[numpy] {n} tokens in {dt:.2f}s "
               f"({n / dt:.2f} tok/s, ttft {ttft:.2f}s)", file=sys.stderr)
     return emitted
 
 
 def _sample_np(logits: np.ndarray, args, rng: np.random.Generator) -> int:
-    """NumPy samplers mirroring ops.sampling semantics."""
+    """NumPy samplers mirroring ops.sampling semantics (all five kinds)."""
     logits = logits.astype(np.float64)
     if args.sampler == "greedy":
         return int(np.argmax(logits))
@@ -123,8 +126,19 @@ def _sample_np(logits: np.ndarray, args, rng: np.random.Generator) -> int:
     p /= p.sum()
     if args.sampler == "min_p":
         keep = p >= p.max() * args.p_base
-        p = np.where(keep, p, 0.0)
-        p /= p.sum()
+    elif args.sampler == "top_k":
+        kth = np.sort(p)[-50]  # Sampler default top_k=50
+        keep = p >= kth
+    elif args.sampler == "top_p":
+        order = np.argsort(p)[::-1]
+        csum = np.cumsum(p[order])
+        keep_sorted = (csum - p[order]) < 0.9  # Sampler default top_p=0.9
+        keep = np.zeros_like(p, dtype=bool)
+        keep[order[keep_sorted]] = True
+    else:  # cdf: plain draw from the full distribution
+        keep = np.ones_like(p, dtype=bool)
+    p = np.where(keep, p, 0.0)
+    p /= p.sum()
     return int(rng.choice(len(p), p=p))
 
 
@@ -177,14 +191,16 @@ def _run_tpu(args) -> str:
                     file=sys.stderr,
                 )
             return text
-        t0 = time.perf_counter()
         text = gen.stream_text(
             tok, args.prompt, args.max_tokens, seed=args.seed,
             echo=lambda s: print(s, end="", flush=True),
         )
         print()
         if args.metrics:
-            dt = time.perf_counter() - t0
-            print(f"[tpu] streamed {args.max_tokens} tokens in {dt:.2f}s",
-                  file=sys.stderr)
+            st = gen.last_stream_stats
+            print(
+                f"[tpu] streamed {st['tokens']} tokens in {st['duration_s']:.2f}s "
+                f"(ttft {st['ttft_s']:.3f}s)",
+                file=sys.stderr,
+            )
         return text

@@ -168,6 +168,7 @@ class Generator:
         self.stop_tokens = tuple(stop_tokens)
         self.cache_dtype = cache_dtype
         self._prefill = make_prefill_fn(config, self.sampler, prefill_attn_impl)
+        self.last_stream_stats: dict[str, Any] = {}
         self._step = make_decode_step_fn(config, self.sampler)
         self._loop = make_decode_loop_fn(config, self.sampler, self.stop_tokens)
 
@@ -271,7 +272,11 @@ class Generator:
         prompt_ids = tokenizer(prompt, return_tensors="np")["input_ids"][0]
         ids: list[int] = []
         emitted = ""
+        t0 = time.perf_counter()
+        ttft = None
         for t in self.stream(prompt_ids, max_new_tokens, seed=seed):
+            if ttft is None:
+                ttft = time.perf_counter() - t0
             ids.append(t)
             text = tokenizer.decode(ids, skip_special_tokens=True)
             # hold back while the last char may still change (e.g. mid UTF-8)
@@ -280,6 +285,17 @@ class Generator:
             delta, emitted = text[len(emitted):], text
             if echo and delta:
                 echo(delta)
+        # final flush of any held-back tail
+        text = tokenizer.decode(ids, skip_special_tokens=True)
+        if text != emitted:
+            if echo:
+                echo(text[len(emitted):])
+            emitted = text
+        self.last_stream_stats = {
+            "tokens": len(ids),
+            "ttft_s": ttft,
+            "duration_s": time.perf_counter() - t0,
+        }
         return emitted
 
 

@@ -1,0 +1,21 @@
+"""Native (C++) runtime components.
+
+The reference's only native code is an inline CUDA softmax; its *runtime*
+(loading, IO) is single-threaded Python/torch.  This package holds the
+framework's C++ pieces, consumed through ctypes (no pybind11 in this
+environment) with transparent pure-Python fallbacks:
+
+- ``safetensors_reader.cc`` — mmap'd safetensors access + multithreaded
+  tensor transpose/cast into preallocated host buffers (the checkpoint
+  load hot path).
+
+Build: ``python -m llm_np_cp_tpu.native.build`` (or lazily on first use).
+"""
+
+from llm_np_cp_tpu.native.bindings import (
+    NativeSafetensorsFile,
+    copy2d,
+    is_available,
+)
+
+__all__ = ["NativeSafetensorsFile", "copy2d", "is_available"]

@@ -1,0 +1,177 @@
+"""ctypes bindings for the native safetensors reader.
+
+Zero-copy design: the shard file is mmap'd once in C++; tensors are numpy
+views over the mapping (no heap copy of the file), and the threaded
+``st_copy2d`` moves/transposes/casts bytes straight into the caller's
+preallocated stacked buffer.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import json
+import os
+import threading
+from typing import Any
+
+import ml_dtypes
+import numpy as np
+
+_lib = None
+_lib_lock = threading.Lock()
+
+
+def _dtype_code(dt: np.dtype) -> int | None:
+    dt = np.dtype(dt)
+    if dt == np.float32:
+        return 0
+    if dt == ml_dtypes.bfloat16:
+        return 1
+    if dt == np.float16:
+        return 2
+    return None
+
+
+_ST_DTYPES = {"F32": np.dtype(np.float32), "BF16": np.dtype(ml_dtypes.bfloat16),
+              "F16": np.dtype(np.float16), "I32": np.dtype(np.int32),
+              "I64": np.dtype(np.int64), "U8": np.dtype(np.uint8),
+              "BOOL": np.dtype(bool)}
+
+
+def _load_lib():
+    global _lib
+    with _lib_lock:
+        if _lib is not None:
+            return _lib
+        from llm_np_cp_tpu.native.build import build
+
+        path = build()
+        if path is None:
+            _lib = False
+            return _lib
+        lib = ctypes.CDLL(str(path))
+        lib.st_open.restype = ctypes.c_void_p
+        lib.st_open.argtypes = [ctypes.c_char_p]
+        lib.st_header.restype = ctypes.c_void_p
+        lib.st_header.argtypes = [ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint64)]
+        lib.st_data.restype = ctypes.c_void_p
+        lib.st_data.argtypes = [ctypes.c_void_p]
+        lib.st_data_size.restype = ctypes.c_uint64
+        lib.st_data_size.argtypes = [ctypes.c_void_p]
+        lib.st_close.argtypes = [ctypes.c_void_p]
+        lib.st_copy2d.argtypes = [
+            ctypes.c_void_p, ctypes.c_int, ctypes.c_void_p, ctypes.c_int,
+            ctypes.c_uint64, ctypes.c_uint64, ctypes.c_int, ctypes.c_int,
+        ]
+        _lib = lib
+        return _lib
+
+
+def is_available() -> bool:
+    return bool(_load_lib())
+
+
+class NativeSafetensorsFile:
+    """mmap-backed safetensors shard: ``keys()``, ``get_tensor(name)``
+    (zero-copy view), ``copy_into(name, dest, transpose)`` (threaded)."""
+
+    def __init__(self, path: str | os.PathLike) -> None:
+        lib = _load_lib()
+        if not lib:
+            raise RuntimeError("native library unavailable")
+        self._lib = lib
+        self._h = lib.st_open(str(path).encode())
+        if not self._h:
+            raise OSError(f"cannot open safetensors file: {path}")
+        hlen = ctypes.c_uint64()
+        hptr = lib.st_header(self._h, ctypes.byref(hlen))
+        header = ctypes.string_at(hptr, hlen.value).decode("utf-8")
+        meta = json.loads(header)
+        meta.pop("__metadata__", None)
+        self._meta = meta
+        nbytes = lib.st_data_size(self._h)
+        self._data = np.ctypeslib.as_array(
+            ctypes.cast(lib.st_data(self._h), ctypes.POINTER(ctypes.c_uint8)),
+            shape=(nbytes,),
+        )
+
+    def keys(self) -> list[str]:
+        return list(self._meta)
+
+    def _entry(self, name: str) -> tuple[np.dtype, tuple[int, ...], int, int]:
+        e = self._meta[name]
+        dt = _ST_DTYPES[e["dtype"]]
+        begin, end = e["data_offsets"]
+        return dt, tuple(e["shape"]), begin, end
+
+    def get_tensor(self, name: str) -> np.ndarray:
+        dt, shape, begin, end = self._entry(name)
+        return self._data[begin:end].view(dt).reshape(shape)
+
+    def copy_into(
+        self, name: str, dest: np.ndarray, *, transpose: bool = False,
+        nthreads: int | None = None,
+    ) -> None:
+        """Threaded copy/transpose/cast of a (≤2-D) tensor into ``dest``."""
+        dt, shape, begin, end = self._entry(name)
+        src_code = _dtype_code(dt)
+        dst_code = _dtype_code(dest.dtype)
+        if src_code is None or dst_code is None or len(shape) > 2:
+            src = self.get_tensor(name)
+            dest[...] = (src.T if transpose else src).astype(dest.dtype)
+            return
+        rows, cols = (shape if len(shape) == 2 else (1, shape[0] if shape else 1))
+        want = (cols, rows) if transpose and len(shape) == 2 else tuple(shape)
+        if tuple(dest.shape) != want:
+            raise ValueError(f"{name}: dest shape {dest.shape} != expected {want}")
+        if not dest.flags.c_contiguous:
+            raise ValueError(f"{name}: dest must be C-contiguous")
+        nthreads = nthreads or min(16, os.cpu_count() or 1)
+        self._lib.st_copy2d(
+            self._data[begin:end].ctypes.data_as(ctypes.c_void_p), src_code,
+            dest.ctypes.data_as(ctypes.c_void_p), dst_code,
+            rows, cols, int(transpose and len(shape) == 2), nthreads,
+        )
+
+    def close(self) -> None:
+        if getattr(self, "_h", None):
+            self._data = None
+            self._lib.st_close(self._h)
+            self._h = None
+
+    def __enter__(self) -> "NativeSafetensorsFile":
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        self.close()
+
+    def __del__(self) -> None:
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+def copy2d(
+    src: np.ndarray, dest: np.ndarray, *, transpose: bool = False,
+    nthreads: int | None = None,
+) -> bool:
+    """Threaded 2-D copy/transpose/cast between host arrays.  Returns False
+    (no-op) when the native library or dtype pair is unsupported."""
+    lib = _load_lib()
+    sc, dc = _dtype_code(src.dtype), _dtype_code(dest.dtype)
+    if not lib or sc is None or dc is None or src.ndim != 2:
+        return False
+    if not (src.flags.c_contiguous and dest.flags.c_contiguous):
+        return False
+    rows, cols = src.shape
+    want = (cols, rows) if transpose else (rows, cols)
+    if tuple(dest.shape) != want:
+        raise ValueError(f"dest shape {dest.shape} != expected {want}")
+    nthreads = nthreads or min(16, os.cpu_count() or 1)
+    lib.st_copy2d(
+        src.ctypes.data_as(ctypes.c_void_p), sc,
+        dest.ctypes.data_as(ctypes.c_void_p), dc,
+        rows, cols, int(transpose), nthreads,
+    )
+    return True

@@ -1,0 +1,30 @@
+"""Build the native library with g++ (no pip/pybind11 — plain C ABI .so)."""
+
+from __future__ import annotations
+
+import subprocess
+from pathlib import Path
+
+SRC = Path(__file__).parent / "safetensors_reader.cc"
+LIB = Path(__file__).parent / "libllmtpu_native.so"
+
+
+def build(force: bool = False) -> Path | None:
+    """Compile the .so if missing/stale.  Returns the path, or None if the
+    toolchain is unavailable (callers fall back to pure Python)."""
+    if LIB.exists() and not force and LIB.stat().st_mtime >= SRC.stat().st_mtime:
+        return LIB
+    cmd = [
+        "g++", "-O3", "-march=native", "-shared", "-fPIC", "-std=c++17",
+        "-o", str(LIB), str(SRC), "-lpthread",
+    ]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+    except (OSError, subprocess.SubprocessError):
+        return None
+    return LIB
+
+
+if __name__ == "__main__":
+    path = build(force=True)
+    print(f"built: {path}" if path else "build failed (g++ unavailable?)")

@@ -1,0 +1,211 @@
+// Native checkpoint IO: mmap'd safetensors access + threaded tensor
+// transform (transpose / dtype cast) into preallocated destination buffers.
+//
+// Role: the data-loading hot path of utils/loading.py.  The reference's
+// loader funnels every tensor through torch on one thread
+// (llama3.2_model.py:1060-1062, :1079); here the Python layer orchestrates
+// and this library does the byte work: the checkpoint shard is mapped
+// read-only (no heap copy of the file), and each tensor is copied /
+// transposed / cast into its slot of the stacked host buffer by a pool of
+// std::threads.  bf16<->f32 conversions use round-to-nearest-even.
+//
+// C ABI only (consumed via ctypes — no pybind11 in this environment).
+
+#include <cstdint>
+#include <cstring>
+#include <fcntl.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <thread>
+#include <unistd.h>
+#include <vector>
+
+extern "C" {
+
+struct StFile {
+  int fd = -1;
+  uint8_t* base = nullptr;  // whole-file mapping
+  size_t size = 0;
+  uint64_t header_len = 0;  // JSON header byte length
+};
+
+// Open + mmap a .safetensors file.  Returns nullptr on failure.
+StFile* st_open(const char* path) {
+  int fd = ::open(path, O_RDONLY);
+  if (fd < 0) return nullptr;
+  struct stat st;
+  if (fstat(fd, &st) != 0 || st.st_size < 8) {
+    ::close(fd);
+    return nullptr;
+  }
+  void* base = mmap(nullptr, st.st_size, PROT_READ, MAP_PRIVATE, fd, 0);
+  if (base == MAP_FAILED) {
+    ::close(fd);
+    return nullptr;
+  }
+  madvise(base, st.st_size, MADV_SEQUENTIAL);
+  auto* f = new StFile;
+  f->fd = fd;
+  f->base = static_cast<uint8_t*>(base);
+  f->size = st.st_size;
+  std::memcpy(&f->header_len, f->base, 8);  // little-endian u64 prefix
+  if (8 + f->header_len > f->size) {  // corrupt header length
+    munmap(base, st.st_size);
+    ::close(fd);
+    delete f;
+    return nullptr;
+  }
+  return f;
+}
+
+const char* st_header(StFile* f, uint64_t* len) {
+  *len = f->header_len;
+  return reinterpret_cast<const char*>(f->base + 8);
+}
+
+// Pointer to the start of the tensor-data region (offsets in the JSON
+// header are relative to this).
+const uint8_t* st_data(StFile* f) { return f->base + 8 + f->header_len; }
+
+uint64_t st_data_size(StFile* f) { return f->size - 8 - f->header_len; }
+
+void st_close(StFile* f) {
+  if (!f) return;
+  munmap(f->base, f->size);
+  ::close(f->fd);
+  delete f;
+}
+
+// ---------------------------------------------------------------------
+// dtype codes: 0 = f32, 1 = bf16, 2 = f16
+// ---------------------------------------------------------------------
+
+static inline float load_elem(const uint8_t* p, int dtype) {
+  switch (dtype) {
+    case 0: {
+      float v;
+      std::memcpy(&v, p, 4);
+      return v;
+    }
+    case 1: {
+      uint16_t h;
+      std::memcpy(&h, p, 2);
+      uint32_t bits = static_cast<uint32_t>(h) << 16;
+      float v;
+      std::memcpy(&v, &bits, 4);
+      return v;
+    }
+    default: {  // f16
+      uint16_t h;
+      std::memcpy(&h, p, 2);
+      uint32_t sign = (h >> 15) & 1, exp = (h >> 10) & 0x1f, man = h & 0x3ff;
+      uint32_t bits;
+      if (exp == 0) {
+        if (man == 0) {
+          bits = sign << 31;
+        } else {  // subnormal
+          int e = -1;
+          while (!(man & 0x400)) {
+            man <<= 1;
+            e++;
+          }
+          man &= 0x3ff;
+          bits = (sign << 31) | ((127 - 15 - e) << 23) | (man << 13);
+        }
+      } else if (exp == 0x1f) {
+        bits = (sign << 31) | 0x7f800000 | (man << 13);
+      } else {
+        bits = (sign << 31) | ((exp - 15 + 127) << 23) | (man << 13);
+      }
+      float v;
+      std::memcpy(&v, &bits, 4);
+      return v;
+    }
+  }
+}
+
+static inline void store_elem(uint8_t* p, int dtype, float v) {
+  switch (dtype) {
+    case 0:
+      std::memcpy(p, &v, 4);
+      return;
+    case 1: {  // f32 -> bf16, round to nearest even
+      uint32_t bits;
+      std::memcpy(&bits, &v, 4);
+      uint32_t rounded = bits + 0x7fff + ((bits >> 16) & 1);
+      uint16_t h = static_cast<uint16_t>(rounded >> 16);
+      if ((bits & 0x7f800000) == 0x7f800000 && (bits & 0x007fffff))
+        h = static_cast<uint16_t>((bits >> 16) | 0x0040);  // quiet NaN
+      std::memcpy(p, &h, 2);
+      return;
+    }
+    default: {  // f32 -> f16 (round to nearest even, with clamping)
+      uint32_t bits;
+      std::memcpy(&bits, &v, 4);
+      uint32_t sign = (bits >> 31) & 1;
+      int32_t exp = static_cast<int32_t>((bits >> 23) & 0xff) - 127 + 15;
+      uint32_t man = bits & 0x7fffff;
+      uint16_t h;
+      if (exp >= 0x1f) {
+        h = static_cast<uint16_t>((sign << 15) | 0x7c00 |
+                                  ((bits & 0x7f800000) == 0x7f800000 && man ? 0x200 : 0));
+      } else if (exp <= 0) {
+        h = static_cast<uint16_t>(sign << 15);  // flush tiny to zero
+      } else {
+        uint32_t m10 = man >> 13;
+        uint32_t rem = man & 0x1fff;
+        if (rem > 0x1000 || (rem == 0x1000 && (m10 & 1))) m10++;
+        h = static_cast<uint16_t>((sign << 15) | (exp << 10) | m10);
+        if (m10 == 0x400) h = static_cast<uint16_t>((sign << 15) | ((exp + 1) << 10));
+      }
+      std::memcpy(p, &h, 2);
+      return;
+    }
+  }
+}
+
+static inline size_t dsize(int dtype) { return dtype == 0 ? 4 : 2; }
+
+// Copy a [rows, cols] tensor from src to dst, optionally transposing to
+// [cols, rows], with dtype conversion, across nthreads.
+void st_copy2d(const uint8_t* src, int src_dtype, uint8_t* dst, int dst_dtype,
+               uint64_t rows, uint64_t cols, int transpose, int nthreads) {
+  const size_t ss = dsize(src_dtype), ds = dsize(dst_dtype);
+  if (nthreads < 1) nthreads = 1;
+  const bool memcpy_ok = (src_dtype == dst_dtype) && !transpose;
+
+  auto worker = [&](uint64_t r0, uint64_t r1) {
+    if (memcpy_ok) {
+      std::memcpy(dst + r0 * cols * ds, src + r0 * cols * ss,
+                  (r1 - r0) * cols * ss);
+      return;
+    }
+    for (uint64_t r = r0; r < r1; ++r) {
+      const uint8_t* sp = src + r * cols * ss;
+      if (!transpose) {
+        uint8_t* dp = dst + r * cols * ds;
+        for (uint64_t c = 0; c < cols; ++c)
+          store_elem(dp + c * ds, dst_dtype, load_elem(sp + c * ss, src_dtype));
+      } else {
+        for (uint64_t c = 0; c < cols; ++c)
+          store_elem(dst + (c * rows + r) * ds, dst_dtype,
+                     load_elem(sp + c * ss, src_dtype));
+      }
+    }
+  };
+
+  if (nthreads == 1 || rows < 64) {
+    worker(0, rows);
+    return;
+  }
+  std::vector<std::thread> pool;
+  uint64_t chunk = (rows + nthreads - 1) / nthreads;
+  for (int t = 0; t < nthreads; ++t) {
+    uint64_t r0 = t * chunk, r1 = std::min(rows, r0 + chunk);
+    if (r0 >= r1) break;
+    pool.emplace_back(worker, r0, r1);
+  }
+  for (auto& th : pool) th.join();
+}
+
+}  // extern "C"

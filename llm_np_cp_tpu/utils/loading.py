@@ -80,18 +80,35 @@ def shard_files(model_dir: str | Path) -> list[Path]:
     )
 
 
+def _open_shard(path: Path, use_native: bool):
+    """Returns (file, native: bool).  The native reader mmaps the shard and
+    does threaded transpose/cast (llm_np_cp_tpu/native); the safetensors
+    python reader is the fallback."""
+    if use_native:
+        try:
+            from llm_np_cp_tpu.native import NativeSafetensorsFile, is_available
+
+            if is_available():
+                return NativeSafetensorsFile(path), True
+        except Exception:
+            pass
+    return safe_open(path, framework="np"), False
+
+
 def load_params(
     model_dir: str | Path,
     config: ModelConfig | None = None,
     *,
     dtype=None,
     shardings: Any = None,
+    use_native: bool = True,
 ) -> tuple[dict[str, Any], ModelConfig]:
     """Load an HF checkpoint directory into the model's param pytree.
 
     dtype: target dtype (default jnp.bfloat16; pass jnp.float32 for parity).
     shardings: optional pytree of jax.sharding.Sharding matching the param
         tree; each buffer is device_put onto it as soon as it is filled.
+    use_native: route tensor bytes through the C++ reader when built.
     Returns (params, config).
     """
     import jax.numpy as jnp
@@ -118,17 +135,25 @@ def load_params(
 
     filled: set[str] = set()
 
-    def fill(dest: np.ndarray, value: np.ndarray, transpose: bool, what: str) -> None:
+    def fill(f, native: bool, key: str, dest: np.ndarray, transpose: bool) -> None:
+        if native:
+            try:
+                f.copy_into(key, dest, transpose=transpose)
+            except ValueError as e:
+                raise ValueError(f"{key}: checkpoint shape mismatch: {e}") from e
+            return
+        value = f.get_tensor(key)
         if transpose:
             value = value.T
         if dest.shape != value.shape:
             raise ValueError(
-                f"{what}: checkpoint shape {value.shape} != expected {dest.shape}"
+                f"{key}: checkpoint shape {value.shape} != expected {dest.shape}"
             )
         dest[...] = value.astype(np_dtype)
 
     for path in shard_files(model_dir):
-        with safe_open(path, framework="np") as f:
+        f, native = _open_shard(path, use_native)
+        with f:
             for key in f.keys():
                 m = _LAYER_RE.match(key)
                 if m:
@@ -138,7 +163,7 @@ def load_params(
                     name, transpose = layer_map[suffix]
                     if name not in host["layers"]:
                         continue
-                    fill(host["layers"][name][idx], f.get_tensor(key), transpose, key)
+                    fill(f, native, key, host["layers"][name][idx], transpose)
                     filled.add(f"layers.{name}.{idx}")
                 elif key in top_map:
                     name, transpose = top_map[key]
@@ -146,7 +171,7 @@ def load_params(
                         continue  # tied: forward reuses embed_tokens
                     if name not in host:
                         continue
-                    fill(host[name], f.get_tensor(key), transpose, key)
+                    fill(f, native, key, host[name], transpose)
                     filled.add(name)
 
     _check_complete(host, filled, config)

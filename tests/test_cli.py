@@ -81,3 +81,26 @@ def test_cli_mesh_sharded(fake_load, capsys):
     b = cli.run(["--backend=tpu", "--sampler=greedy", "--max-tokens=4",
                  "--dtype=f32", "--no-stream"])
     assert a == b
+
+
+def test_cli_numpy_all_samplers_run(fake_load, capsys):
+    """Every parser-accepted sampler works on the numpy backend too."""
+    for sampler in ["greedy", "min_p", "cdf", "top_k", "top_p"]:
+        out = cli.run(["--backend=numpy", f"--sampler={sampler}",
+                       "--max-tokens=3", "--prompt=hi"])
+        assert isinstance(out, str) and out
+
+
+def test_cli_numpy_metrics_counts_generated(fake_load, capsys):
+    cli.run(["--backend=numpy", "--sampler=greedy", "--max-tokens=4",
+             "--metrics", "--prompt=hi"])
+    err = capsys.readouterr().err
+    assert "4 tokens" in err or "3 tokens" in err  # early EOS allowed
+
+
+def test_cli_stream_metrics_counts_generated(fake_load, capsys):
+    cli.run(["--backend=tpu", "--sampler=greedy", "--max-tokens=4",
+             "--dtype=f32", "--metrics", "--prompt=hi"])
+    err = capsys.readouterr().err
+    assert "streamed" in err and "ttft" in err
+    assert "streamed 4 tokens" in err or "streamed 3" in err

@@ -1,0 +1,94 @@
+"""Native C++ safetensors reader vs Python reference behavior."""
+
+import jax
+import jax.numpy as jnp
+import ml_dtypes
+import numpy as np
+import pytest
+from safetensors.numpy import save_file
+
+from llm_np_cp_tpu.native import NativeSafetensorsFile, copy2d, is_available
+from llm_np_cp_tpu.config import tiny_config
+from llm_np_cp_tpu.models.transformer import init_params
+from llm_np_cp_tpu.utils.loading import load_params
+
+pytestmark = pytest.mark.skipif(
+    not is_available(), reason="native toolchain unavailable"
+)
+
+
+@pytest.fixture
+def shard(tmp_path, rng_np):
+    tensors = {
+        "a": rng_np.standard_normal((64, 48), dtype=np.float32),
+        "b": rng_np.standard_normal((128,), dtype=np.float32).astype(ml_dtypes.bfloat16),
+        "c": rng_np.standard_normal((8, 8), dtype=np.float32).astype(np.float16),
+    }
+    path = tmp_path / "shard.safetensors"
+    save_file(tensors, str(path))
+    return path, tensors
+
+
+def test_keys_and_zero_copy_views(shard):
+    path, tensors = shard
+    with NativeSafetensorsFile(path) as f:
+        assert sorted(f.keys()) == ["a", "b", "c"]
+        for k, want in tensors.items():
+            got = f.get_tensor(k)
+            assert got.dtype == want.dtype
+            np.testing.assert_array_equal(got, want)
+
+
+def test_copy_into_transpose_and_cast(shard):
+    path, tensors = shard
+    with NativeSafetensorsFile(path) as f:
+        # f32 -> f32 transpose
+        dest = np.empty((48, 64), dtype=np.float32)
+        f.copy_into("a", dest, transpose=True)
+        np.testing.assert_array_equal(dest, tensors["a"].T)
+        # f32 -> bf16 cast (round-to-nearest-even must match ml_dtypes)
+        dest16 = np.empty((64, 48), dtype=ml_dtypes.bfloat16)
+        f.copy_into("a", dest16)
+        np.testing.assert_array_equal(dest16, tensors["a"].astype(ml_dtypes.bfloat16))
+        # bf16 -> f32 upcast (exact)
+        dest_b = np.empty((128,), dtype=np.float32)
+        f.copy_into("b", dest_b)
+        np.testing.assert_array_equal(dest_b, tensors["b"].astype(np.float32))
+        # f16 -> f32 upcast (exact)
+        dest_c = np.empty((8, 8), dtype=np.float32)
+        f.copy_into("c", dest_c)
+        np.testing.assert_array_equal(dest_c, tensors["c"].astype(np.float32))
+
+
+def test_copy_into_shape_mismatch(shard):
+    path, _ = shard
+    with NativeSafetensorsFile(path) as f:
+        with pytest.raises(ValueError, match="shape"):
+            f.copy_into("a", np.empty((64, 47), dtype=np.float32))
+
+
+def test_copy2d_threaded(rng_np):
+    src = rng_np.standard_normal((300, 70), dtype=np.float32)
+    dst = np.empty((70, 300), dtype=ml_dtypes.bfloat16)
+    assert copy2d(src, dst, transpose=True, nthreads=8)
+    np.testing.assert_array_equal(
+        dst, np.ascontiguousarray(src.T).astype(ml_dtypes.bfloat16)
+    )
+
+
+def test_loader_native_equals_python(tmp_path):
+    from tests.test_loading import hf_tensors, write_checkpoint
+
+    cfg = tiny_config("llama")
+    src_np = jax.tree.map(
+        lambda x: np.asarray(x, np.float32),
+        init_params(jax.random.PRNGKey(0), cfg, dtype=jnp.float32),
+    )
+    write_checkpoint(tmp_path, cfg, hf_tensors(src_np, "llama"), shards=2)
+
+    a, _ = load_params(tmp_path, dtype=jnp.bfloat16, use_native=True)
+    b, _ = load_params(tmp_path, dtype=jnp.bfloat16, use_native=False)
+    jax.tree.map(
+        lambda x, y: np.testing.assert_array_equal(np.asarray(x), np.asarray(y)),
+        a, b,
+    )
