@@ -71,9 +71,17 @@ def make_prefill_fn(
     """
 
     @jax.jit
-    def prefill(params: Params, prompt_ids: jnp.ndarray, cache: KVCache, key: jax.Array):
+    def prefill(
+        params: Params,
+        prompt_ids: jnp.ndarray,
+        cache: KVCache,
+        key: jax.Array,
+        attn_mask: jnp.ndarray | None = None,
+        pad_offsets: jnp.ndarray | None = None,
+    ):
         logits, cache = forward(
             params, prompt_ids, config, cache, logits_last_only=True,
+            attn_mask=attn_mask, pad_offsets=pad_offsets,
             attn_impl=attn_impl,
         )
         tok = sampler(key, logits[:, -1])
@@ -116,13 +124,15 @@ def make_decode_loop_fn(
         cache: KVCache,
         key: jax.Array,
         num_steps: int,
+        pad_offsets: jnp.ndarray | None = None,
     ):
         keys = jax.random.split(key, num_steps)
 
         def body(carry, k):
             tok, cache, done = carry
             logits, cache = forward(
-                params, tok[:, None], config, cache, logits_last_only=True
+                params, tok[:, None], config, cache, logits_last_only=True,
+                pad_offsets=pad_offsets,
             )
             nxt = sampler(k, logits[:, -1])
             if stops is not None:
@@ -208,6 +218,73 @@ class Generator:
             rest.block_until_ready()
             t2 = time.perf_counter()
             tokens = np.concatenate([np.asarray(tok0)[:, None], np.asarray(rest)], axis=1)
+            rate = (max_new_tokens - 1) / (t2 - t1)
+        else:
+            tokens = np.asarray(tok0)[:, None]
+            rate = float("nan")
+
+        tokens = _trim_after_stop(tokens, self.stop_tokens)
+        return GenerateResult(
+            tokens=tokens,
+            ttft_s=t1 - t0,
+            decode_tokens_per_s=rate,
+            num_generated=tokens.shape[1],
+        )
+
+    # -- ragged batch --------------------------------------------------
+    def generate_ragged(
+        self,
+        prompts: list[np.ndarray | list[int]],
+        max_new_tokens: int,
+        *,
+        max_seq_len: int | None = None,
+        seed: int = 0,
+    ) -> GenerateResult:
+        """Batch generation over prompts of different lengths.
+
+        Prompts are LEFT-padded to a common length; per-row ``pad_offsets``
+        keep RoPE positions and causal masks exact (each row behaves as if
+        it ran alone — verified in tests), and the pad slots are marked
+        invalid in the cache bitmap.  The reference has no batching at all
+        (its generate loop is bs=1, llama3.2_model.py:865-902).
+        """
+        arrs = [np.asarray(p, dtype=np.int32).reshape(-1) for p in prompts]
+        lens = [a.size for a in arrs]
+        s = max(lens)
+        b = len(arrs)
+        ids = np.zeros((b, s), dtype=np.int32)
+        mask = np.zeros((b, s), dtype=bool)
+        pads = np.zeros(b, dtype=np.int32)
+        for i, a in enumerate(arrs):
+            pads[i] = s - a.size
+            ids[i, pads[i]:] = a
+            mask[i, pads[i]:] = True
+
+        max_seq_len = max_seq_len or s + max_new_tokens
+        _check_capacity(s, max_new_tokens, max_seq_len)
+        key = jax.random.PRNGKey(seed)
+        k_pre, k_loop = jax.random.split(key)
+        cache = self._init_cache(b, max_seq_len)
+        pad_offsets = jnp.asarray(pads)
+
+        t0 = time.perf_counter()
+        tok0, cache, _ = self._prefill(
+            self.params, jnp.asarray(ids), cache, k_pre,
+            jnp.asarray(mask), pad_offsets,
+        )
+        tok0.block_until_ready()
+        t1 = time.perf_counter()
+
+        if max_new_tokens > 1:
+            rest, cache = self._loop(
+                self.params, tok0, cache, k_loop, max_new_tokens - 1,
+                pad_offsets,
+            )
+            rest.block_until_ready()
+            t2 = time.perf_counter()
+            tokens = np.concatenate(
+                [np.asarray(tok0)[:, None], np.asarray(rest)], axis=1
+            )
             rate = (max_new_tokens - 1) / (t2 - t1)
         else:
             tokens = np.asarray(tok0)[:, None]

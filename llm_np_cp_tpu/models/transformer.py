@@ -122,6 +122,7 @@ def forward(
     *,
     positions: jnp.ndarray | None = None,
     attn_mask: jnp.ndarray | None = None,
+    pad_offsets: jnp.ndarray | None = None,
     logits_last_only: bool = False,
     output_hidden_states: bool = False,
     output_attentions: bool = False,
@@ -136,6 +137,13 @@ def forward(
         ``cache.length + arange(S)`` (cache-aware positions, the reference's
         llama3.2_model.py:651-664).
     attn_mask: optional [B, S] bool marking valid (non-pad) input tokens.
+    pad_offsets: optional [B] int32 — per-row LEFT-padding amounts for
+        ragged batches.  Row b's token in cache slot j carries absolute
+        position ``j - pad_offsets[b]``; RoPE and causal masks become
+        row-aware, so sequences of different lengths batch together with
+        correct relative positions (combine with attn_mask marking the pad
+        slots invalid).  The reference can't batch at all (bs=1 generate
+        loop, SURVEY §2.8).
     logits_last_only: compute lm_head for the final position only — the
         reference computes logits for ALL positions then samples from the
         last (llama3.2_model.py:803, :891), an O(S·V) waste in prefill.
@@ -164,6 +172,10 @@ def forward(
     if positions is None:
         positions = offset + jnp.arange(s, dtype=jnp.int32)[None, :]
         positions = jnp.broadcast_to(positions, (b, s))
+        if pad_offsets is not None:
+            # left-padded ragged rows: clamp so pad slots get position 0
+            # (they are masked out of attention; RoPE just needs validity)
+            positions = jnp.maximum(positions - pad_offsets[:, None], 0)
 
     x = params["embed_tokens"][input_ids].astype(compute_dtype)
     if config.scale_embeddings:
@@ -178,6 +190,8 @@ def forward(
     # variant inside the scan).
     if cache is not None:
         kv_positions = jnp.arange(cache.max_seq_len, dtype=jnp.int32)
+        if pad_offsets is not None:
+            kv_positions = kv_positions[None, :] - pad_offsets[:, None]
         # Persist per-slot validity so pad tokens masked out in an earlier
         # chunk stay masked in later calls (the bitmap is the source of
         # truth; slots never written are also False).
