@@ -3,8 +3,19 @@
 Runs the flagship Llama-3.2-1B architecture (random bf16 weights — no
 checkpoint downloads in this environment; decode throughput is
 weight-value-independent) with the fused device-side decode loop:
-prefill seq=128, then one jitted lax.scan of decode steps, bs=1
-(BASELINE config 1 shape).
+prefill seq=128, then one jitted lax.scan of decode steps.
+
+Headline = aggregate decode tokens/sec/chip at batch=8 (the north-star
+1,000 tok/s/chip target is unreachable at bs=1 by the HBM roofline:
+1.24B bf16 params = 2.47 GB read per step ÷ ~819 GB/s ≈ 331 steps/s
+ceiling; batching amortizes the weight stream — BASELINE config 3 uses
+bs=8).  bs=1 and bs=32 rates plus TTFT are in "detail".
+
+Measurement notes (tunneled TPU): the transport dedupes repeated
+executions with identical live inputs and ``block_until_ready`` is not a
+reliable fence, so every timed iteration feeds FRESH inputs (chained to
+the previous iteration's output host-side) and forces a real D2H
+materialization with ``np.asarray`` before reading the clock.
 
 Prints ONE JSON line:
   {"metric": "decode_tokens_per_sec_per_chip", "value": N,
@@ -24,8 +35,39 @@ import jax.numpy as jnp
 import numpy as np
 
 
-def main() -> None:
+def _measure(config, params, prefill, loop, batch, prompt_len, decode_tokens, reps=3):
+    """Median TTFT + aggregate decode rate over ``reps`` fresh-input runs."""
     from llm_np_cp_tpu.cache import KVCache
+
+    key = jax.random.PRNGKey(0)
+    max_seq = prompt_len + decode_tokens + 8
+    rng = np.random.default_rng(batch)
+    carry = rng.integers(0, config.vocab_size, (batch, prompt_len))
+
+    def one(prompt_host):
+        cache = KVCache.init(config, batch, max_seq, dtype=jnp.bfloat16)
+        t0 = time.perf_counter()
+        tok0, cache, _ = prefill(params, jnp.asarray(prompt_host, jnp.int32), cache, key)
+        np.asarray(tok0)  # force real D2H — block_until_ready is not a fence here
+        t1 = time.perf_counter()
+        toks, cache = loop(params, tok0, cache, key, decode_tokens)
+        toks_host = np.asarray(toks)
+        t2 = time.perf_counter()
+        return t1 - t0, t2 - t1, toks_host
+
+    _, _, toks_host = one(carry)  # warmup: compile both programs
+    ttfts, rates = [], []
+    for i in range(reps):
+        # chain inputs through the previous output so the transport cannot
+        # serve a deduped result for a repeated (executable, args) pair
+        carry = (carry + int(toks_host.sum()) + i + 1) % config.vocab_size
+        ttft, dec, toks_host = one(carry)
+        ttfts.append(ttft)
+        rates.append(batch * decode_tokens / dec)
+    return float(np.median(ttfts)), float(np.median(rates))
+
+
+def main() -> None:
     from llm_np_cp_tpu.config import LLAMA_3_2_1B
     from llm_np_cp_tpu.generate import make_decode_loop_fn, make_prefill_fn
     from llm_np_cp_tpu.models.transformer import init_params
@@ -34,49 +76,35 @@ def main() -> None:
     config = LLAMA_3_2_1B
     prompt_len = 128
     decode_tokens = 256
-    max_seq = prompt_len + decode_tokens + 8
 
     params = init_params(jax.random.PRNGKey(0), config, dtype=jnp.bfloat16)
     sampler = Sampler(kind="greedy")
     prefill = make_prefill_fn(config, sampler)
     loop = make_decode_loop_fn(config, sampler)
 
-    prompt = jnp.asarray(
-        np.random.default_rng(0).integers(0, config.vocab_size, (1, prompt_len)),
-        jnp.int32,
-    )
-    key = jax.random.PRNGKey(0)
+    detail = {}
+    for batch in (1, 8, 32):
+        ttft, rate = _measure(
+            config, params, prefill, loop, batch, prompt_len, decode_tokens
+        )
+        detail[f"bs{batch}"] = {
+            "decode_tok_s_chip": round(rate, 1),
+            "per_seq_tok_s": round(rate / batch, 1),
+            "ttft_s_p50": round(ttft, 4),
+        }
 
-    def run():
-        cache = KVCache.init(config, 1, max_seq, dtype=jnp.bfloat16)
-        t0 = time.perf_counter()
-        tok0, cache, _ = prefill(params, prompt, cache, key)
-        tok0.block_until_ready()
-        t1 = time.perf_counter()
-        toks, cache = loop(params, tok0, cache, key, decode_tokens)
-        toks.block_until_ready()
-        t2 = time.perf_counter()
-        return t1 - t0, t2 - t1
-
-    run()  # warmup: compile both programs
-    ttfts, rates = [], []
-    for _ in range(3):
-        ttft, dec = run()
-        ttfts.append(ttft)
-        rates.append(decode_tokens / dec)
-
-    rate = float(np.median(rates))
+    rate = detail["bs8"]["decode_tok_s_chip"]
     result = {
         "metric": "decode_tokens_per_sec_per_chip",
-        "value": round(rate, 1),
+        "value": rate,
         "unit": "tokens/s/chip",
         "vs_baseline": round(rate / 1000.0, 3),
         "detail": {
             "model": "Llama-3.2-1B (random bf16 weights)",
             "prompt_len": prompt_len,
             "decode_tokens": decode_tokens,
-            "batch": 1,
-            "ttft_s_p50": round(float(np.median(ttfts)), 4),
+            "headline_batch": 8,
+            **detail,
             "backend": jax.default_backend(),
             "device": str(jax.devices()[0]),
         },

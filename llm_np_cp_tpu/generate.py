@@ -185,19 +185,16 @@ class Generator:
     def _init_cache(self, batch: int, max_seq_len: int) -> KVCache:
         return KVCache.init(self.config, batch, max_seq_len, dtype=self.cache_dtype)
 
-    # -- fused ---------------------------------------------------------
-    def generate(
+    def _run_fused(
         self,
-        prompt_ids: np.ndarray | jnp.ndarray,
+        prompt_ids: jnp.ndarray,
         max_new_tokens: int,
-        *,
-        max_seq_len: int | None = None,
-        seed: int = 0,
+        max_seq_len: int | None,
+        seed: int,
+        attn_mask: jnp.ndarray | None = None,
+        pad_offsets: jnp.ndarray | None = None,
     ) -> GenerateResult:
-        """Fused generation: 2 device dispatches total (prefill, decode scan)."""
-        prompt_ids = jnp.asarray(prompt_ids, dtype=jnp.int32)
-        if prompt_ids.ndim == 1:
-            prompt_ids = prompt_ids[None, :]
+        """Shared fused runner: prefill dispatch + decode-scan dispatch."""
         b, s = prompt_ids.shape
         max_seq_len = max_seq_len or s + max_new_tokens
         _check_capacity(s, max_new_tokens, max_seq_len)
@@ -207,13 +204,15 @@ class Generator:
         cache = self._init_cache(b, max_seq_len)
 
         t0 = time.perf_counter()
-        tok0, cache, _ = self._prefill(self.params, prompt_ids, cache, k_pre)
+        tok0, cache, _ = self._prefill(
+            self.params, prompt_ids, cache, k_pre, attn_mask, pad_offsets
+        )
         tok0.block_until_ready()
         t1 = time.perf_counter()
 
         if max_new_tokens > 1:
             rest, cache = self._loop(
-                self.params, tok0, cache, k_loop, max_new_tokens - 1
+                self.params, tok0, cache, k_loop, max_new_tokens - 1, pad_offsets
             )
             rest.block_until_ready()
             t2 = time.perf_counter()
@@ -230,6 +229,21 @@ class Generator:
             decode_tokens_per_s=rate,
             num_generated=tokens.shape[1],
         )
+
+    # -- fused ---------------------------------------------------------
+    def generate(
+        self,
+        prompt_ids: np.ndarray | jnp.ndarray,
+        max_new_tokens: int,
+        *,
+        max_seq_len: int | None = None,
+        seed: int = 0,
+    ) -> GenerateResult:
+        """Fused generation: 2 device dispatches total (prefill, decode scan)."""
+        prompt_ids = jnp.asarray(prompt_ids, dtype=jnp.int32)
+        if prompt_ids.ndim == 1:
+            prompt_ids = prompt_ids[None, :]
+        return self._run_fused(prompt_ids, max_new_tokens, max_seq_len, seed)
 
     # -- ragged batch --------------------------------------------------
     def generate_ragged(
@@ -260,42 +274,13 @@ class Generator:
             ids[i, pads[i]:] = a
             mask[i, pads[i]:] = True
 
-        max_seq_len = max_seq_len or s + max_new_tokens
-        _check_capacity(s, max_new_tokens, max_seq_len)
-        key = jax.random.PRNGKey(seed)
-        k_pre, k_loop = jax.random.split(key)
-        cache = self._init_cache(b, max_seq_len)
-        pad_offsets = jnp.asarray(pads)
-
-        t0 = time.perf_counter()
-        tok0, cache, _ = self._prefill(
-            self.params, jnp.asarray(ids), cache, k_pre,
-            jnp.asarray(mask), pad_offsets,
-        )
-        tok0.block_until_ready()
-        t1 = time.perf_counter()
-
-        if max_new_tokens > 1:
-            rest, cache = self._loop(
-                self.params, tok0, cache, k_loop, max_new_tokens - 1,
-                pad_offsets,
-            )
-            rest.block_until_ready()
-            t2 = time.perf_counter()
-            tokens = np.concatenate(
-                [np.asarray(tok0)[:, None], np.asarray(rest)], axis=1
-            )
-            rate = (max_new_tokens - 1) / (t2 - t1)
-        else:
-            tokens = np.asarray(tok0)[:, None]
-            rate = float("nan")
-
-        tokens = _trim_after_stop(tokens, self.stop_tokens)
-        return GenerateResult(
-            tokens=tokens,
-            ttft_s=t1 - t0,
-            decode_tokens_per_s=rate,
-            num_generated=tokens.shape[1],
+        return self._run_fused(
+            jnp.asarray(ids),
+            max_new_tokens,
+            max_seq_len,
+            seed,
+            attn_mask=jnp.asarray(mask),
+            pad_offsets=jnp.asarray(pads),
         )
 
     # -- streaming -----------------------------------------------------

@@ -165,6 +165,14 @@ def forward(
     """
     if output_attentions and attn_impl != "xla":
         raise ValueError("output_attentions requires attn_impl='xla'")
+    if attn_impl == "flash" and (attn_mask is not None or pad_offsets is not None):
+        # the Pallas kernel builds its causal mask from slot index alone —
+        # it cannot see per-row validity/position shifts, so ragged inputs
+        # would silently attend pad slots
+        raise ValueError(
+            "attn_impl='flash' does not support attn_mask/pad_offsets "
+            "(ragged batches); use attn_impl='xla'"
+        )
     b, s = input_ids.shape
     compute_dtype = params["embed_tokens"].dtype
 
