@@ -114,6 +114,145 @@ def _project(x: jnp.ndarray, w: jnp.ndarray) -> jnp.ndarray:
     )
 
 
+def embed_inputs(params: Params, input_ids: jnp.ndarray, config: ModelConfig) -> jnp.ndarray:
+    """Token embedding lookup (+ Gemma's sqrt(hidden) scaling,
+    gemma2_model.py:738-739, applied in the weight dtype to match the
+    reference's bf16 rounding)."""
+    compute_dtype = params["embed_tokens"].dtype
+    x = params["embed_tokens"][input_ids].astype(compute_dtype)
+    if config.scale_embeddings:
+        normalizer = jnp.array(math.sqrt(config.hidden_size), dtype=compute_dtype)
+        x = x * normalizer
+    return x
+
+
+def final_logits(
+    params: Params, x: jnp.ndarray, config: ModelConfig, *, last_only: bool = False
+) -> jnp.ndarray:
+    """Final RMSNorm → (tied) lm_head → optional softcap → float32 logits."""
+    x = rms_norm(
+        x, params["final_norm"], eps=config.rms_norm_eps,
+        unit_offset=config.rms_norm_unit_offset,
+    )
+    if last_only:
+        x = x[:, -1:, :]
+    if config.tie_word_embeddings:
+        logits = jnp.einsum(
+            "bsh,vh->bsv", x, params["embed_tokens"],
+            preferred_element_type=jnp.float32,
+        )
+    else:
+        logits = jnp.einsum(
+            "bsh,hv->bsv", x, params["lm_head"],
+            preferred_element_type=jnp.float32,
+        )
+    if config.final_logit_softcapping is not None:
+        logits = softcap(logits, config.final_logit_softcapping)
+    return logits.astype(jnp.float32)
+
+
+def run_decoder_layer(
+    w: Params,
+    x: jnp.ndarray,
+    *,
+    config: ModelConfig,
+    act: Any,
+    cos: jnp.ndarray,
+    sin: jnp.ndarray,
+    mask_global: jnp.ndarray,
+    mask_local: jnp.ndarray | None = None,
+    sliding: jnp.ndarray | bool = False,
+    attn_impl: str = "xla",
+    kv_update: Any = None,
+    output_attentions: bool = False,
+) -> tuple[jnp.ndarray, tuple[jnp.ndarray, jnp.ndarray], jnp.ndarray | None]:
+    """One decoder block (pre-norm or Gemma sandwich-norm residual).
+
+    w: one layer's weight dict (un-stacked leaves).
+    kv_update: optional ``(k, v) -> (k_att, v_att)`` hook — the cache write;
+        when None, attention runs over the freshly projected K/V (the
+        reference's cache-less mode, llama3.2_model.py:874-880).
+    sliding: traced bool — selects ``mask_local`` (and the flash kernel's
+        window) for Gemma-2's alternating local layers.
+
+    Returns ``(x_out, (k_att, v_att), attn_weights | None)``.  Shared by
+    ``forward``'s lax.scan and the pipeline-parallel schedule
+    (parallel/pipeline.py), so both trace identical layer math.
+    """
+    mask = (
+        jnp.where(sliding, mask_local, mask_global)
+        if config.sliding_window is not None
+        else mask_global
+    )
+    b, s = x.shape[:2]
+    h = rms_norm(
+        x, w["ln_attn_in"], eps=config.rms_norm_eps,
+        unit_offset=config.rms_norm_unit_offset,
+    )
+    q = _project(h, w["q_proj"]).reshape(b, s, config.num_attention_heads, config.head_dim)
+    k = _project(h, w["k_proj"]).reshape(b, s, config.num_key_value_heads, config.head_dim)
+    v = _project(h, w["v_proj"]).reshape(b, s, config.num_key_value_heads, config.head_dim)
+    q = apply_rope(q, cos, sin)
+    k = apply_rope(k, cos, sin)
+
+    if kv_update is not None:
+        k_att, v_att = kv_update(k, v)
+    else:
+        k_att, v_att = k, v
+
+    attn_weights = None
+    if attn_impl == "flash":
+        from llm_np_cp_tpu.ops.pallas.flash_attention import flash_attention
+
+        def _flash(window):
+            return flash_attention(
+                q, k, v,  # current K/V: self-attention over 0..S-1
+                scale=config.attn_scale,
+                logit_softcap=config.attn_logit_softcapping,
+                window=window,
+            )
+
+        if config.sliding_window is not None:
+            attn = lax.cond(
+                sliding,
+                lambda: _flash(config.sliding_window),
+                lambda: _flash(None),
+            )
+        else:
+            attn = _flash(None)
+    else:
+        attn = gqa_attention(
+            q, k_att, v_att, mask,
+            scale=config.attn_scale,
+            logit_softcap=config.attn_logit_softcapping,
+            return_weights=output_attentions,
+        )
+        if output_attentions:
+            attn, attn_weights = attn
+    attn = _project(attn.reshape(b, s, -1), w["o_proj"])
+    if config.sandwich_norms:
+        attn = rms_norm(
+            attn, w["ln_attn_out"], eps=config.rms_norm_eps,
+            unit_offset=config.rms_norm_unit_offset,
+        )
+    x = x + attn
+
+    h = rms_norm(
+        x, w["ln_mlp_in"], eps=config.rms_norm_eps,
+        unit_offset=config.rms_norm_unit_offset,
+    )
+    gate = act(_project(h, w["gate_proj"]))
+    up = _project(h, w["up_proj"])
+    mlp = _project(gate * up, w["down_proj"])
+    if config.sandwich_norms:
+        mlp = rms_norm(
+            mlp, w["ln_mlp_out"], eps=config.rms_norm_eps,
+            unit_offset=config.rms_norm_unit_offset,
+        )
+    x = x + mlp
+    return x, (k_att, v_att), attn_weights
+
+
 def forward(
     params: Params,
     input_ids: jnp.ndarray,
@@ -185,12 +324,7 @@ def forward(
             # (they are masked out of attention; RoPE just needs validity)
             positions = jnp.maximum(positions - pad_offsets[:, None], 0)
 
-    x = params["embed_tokens"][input_ids].astype(compute_dtype)
-    if config.scale_embeddings:
-        # Gemma: normalizer in the *weight* dtype then cast — matches the
-        # reference's bf16 sqrt(hidden) rounding (gemma2_model.py:738-739).
-        normalizer = jnp.array(math.sqrt(config.hidden_size), dtype=compute_dtype)
-        x = x * normalizer
+    x = embed_inputs(params, input_ids, config)
 
     cos, sin = rope_cos_sin(positions, config, dtype=jnp.float32)
 
@@ -243,76 +377,19 @@ def forward(
     def layer_step(x: jnp.ndarray, xs: tuple) -> tuple[jnp.ndarray, tuple]:
         w, k_l, v_l, sliding = xs
         x_in = x  # layer input (collected when output_hidden_states)
-
-        # --- attention block ---
-        h = rms_norm(
-            x, w["ln_attn_in"], eps=config.rms_norm_eps,
-            unit_offset=config.rms_norm_unit_offset,
+        kv_update = (
+            (lambda k, v: update_layer(k_l, v_l, k, v, offset))
+            if cache is not None
+            else None
         )
-        q = _project(h, w["q_proj"]).reshape(b, s, config.num_attention_heads, config.head_dim)
-        k = _project(h, w["k_proj"]).reshape(b, s, config.num_key_value_heads, config.head_dim)
-        v = _project(h, w["v_proj"]).reshape(b, s, config.num_key_value_heads, config.head_dim)
-        q = apply_rope(q, cos, sin)
-        k = apply_rope(k, cos, sin)
-
+        x, kv_att, attn_weights = run_decoder_layer(
+            w, x, config=config, act=act, cos=cos, sin=sin,
+            mask_global=mask_global, mask_local=mask_local,
+            sliding=sliding, attn_impl=attn_impl, kv_update=kv_update,
+            output_attentions=output_attentions,
+        )
         if cache is not None:
-            k_l, v_l = update_layer(k_l, v_l, k, v, offset)
-            k_att, v_att = k_l, v_l
-        else:
-            k_att, v_att = k, v
-
-        attn_weights = None
-        if attn_impl == "flash":
-            from llm_np_cp_tpu.ops.pallas.flash_attention import flash_attention
-
-            def _flash(window):
-                return flash_attention(
-                    q, k, v,  # current K/V: self-attention over 0..S-1
-                    scale=config.attn_scale,
-                    logit_softcap=config.attn_logit_softcapping,
-                    window=window,
-                )
-
-            if config.sliding_window is not None:
-                attn = lax.cond(
-                    sliding,
-                    lambda: _flash(config.sliding_window),
-                    lambda: _flash(None),
-                )
-            else:
-                attn = _flash(None)
-        else:
-            mask = jnp.where(sliding, mask_local, mask_global) if config.sliding_window else mask_global
-            attn = gqa_attention(
-                q, k_att, v_att, mask,
-                scale=config.attn_scale,
-                logit_softcap=config.attn_logit_softcapping,
-                return_weights=output_attentions,
-            )
-            if output_attentions:
-                attn, attn_weights = attn
-        attn = _project(attn.reshape(b, s, -1), w["o_proj"])
-        if config.sandwich_norms:
-            attn = rms_norm(
-                attn, w["ln_attn_out"], eps=config.rms_norm_eps,
-                unit_offset=config.rms_norm_unit_offset,
-            )
-        x = x + attn
-
-        # --- MLP block ---
-        h = rms_norm(
-            x, w["ln_mlp_in"], eps=config.rms_norm_eps,
-            unit_offset=config.rms_norm_unit_offset,
-        )
-        gate = act(_project(h, w["gate_proj"]))
-        up = _project(h, w["up_proj"])
-        mlp = _project(gate * up, w["down_proj"])
-        if config.sandwich_norms:
-            mlp = rms_norm(
-                mlp, w["ln_mlp_out"], eps=config.rms_norm_eps,
-                unit_offset=config.rms_norm_unit_offset,
-            )
-        x = x + mlp
+            k_l, v_l = kv_att  # updated cache slabs (flash also writes them)
 
         ys: tuple = (k_l, v_l)
         if output_hidden_states:
@@ -331,28 +408,7 @@ def forward(
     if output_attentions:
         aux["attentions"] = scan_out[pos_idx]  # [L, B, H, Sq, Skv]
 
-    x = rms_norm(
-        x, params["final_norm"], eps=config.rms_norm_eps,
-        unit_offset=config.rms_norm_unit_offset,
-    )
-
-    if logits_last_only:
-        x_logits = x[:, -1:, :]
-    else:
-        x_logits = x
-    if config.tie_word_embeddings:
-        logits = jnp.einsum(
-            "bsh,vh->bsv", x_logits, params["embed_tokens"],
-            preferred_element_type=jnp.float32,
-        )
-    else:
-        logits = jnp.einsum(
-            "bsh,hv->bsv", x_logits, params["lm_head"],
-            preferred_element_type=jnp.float32,
-        )
-    if config.final_logit_softcapping is not None:
-        logits = softcap(logits, config.final_logit_softcapping)
-    logits = logits.astype(jnp.float32)
+    logits = final_logits(params, x, config, last_only=logits_last_only)
 
     new_cache = None
     if cache is not None:

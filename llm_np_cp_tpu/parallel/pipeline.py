@@ -1,0 +1,221 @@
+"""GPipe-style pipeline parallelism over the "pipe" mesh axis.
+
+The reference has no pipeline parallelism (SURVEY §2.9: a single
+sequential layer loop, llama3.2_model.py:685-697).  This module is the
+TPU-native design — no send/recv threads, no NCCL process groups, no
+scheduler daemon.  The entire schedule is ONE traced program:
+
+- stacked layer weights ``[L, ...]`` are sharded on their leading axis
+  across P pipeline stages (``shard_map`` manual over "pipe" only; GSPMD
+  keeps auto-partitioning DP/TP on the other mesh axes inside each stage);
+- the batch is split into M microbatches; at step t, stage p runs
+  microbatch t−p through its local layer block (``lax.scan``), then
+  rotates activations one hop along the ring with ``lax.ppermute`` (ICI
+  neighbor exchange — XLA overlaps it with the next stage's compute);
+- M + P − 1 steps drain the pipeline; the last stage accumulates outputs,
+  broadcast back with a masked ``psum``.
+
+``jax.grad`` differentiates straight through the schedule (ppermute's
+transpose is the reverse permutation), so the pipelined loss gives exact
+GPipe gradients — no hand-written backward pass.
+
+Embedding, final norm, and lm_head run outside the pipelined region,
+replicated over "pipe" (sharded by DP/TP as usual): for decoder LLMs the
+embed/head FLOPs are tiny next to L layer blocks, and keeping them out of
+the ring avoids special first/last-stage weight placement.
+
+Scope: training and cache-less forward (the reference's full-recompute
+mode).  Cached decode composes with DP/TP/SP instead — PP adds latency to
+autoregressive decode, which is why inference frameworks shard depth-wise
+only under memory pressure.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from llm_np_cp_tpu.config import ModelConfig
+from llm_np_cp_tpu.models.transformer import (
+    embed_inputs,
+    final_logits,
+    run_decoder_layer,
+)
+from llm_np_cp_tpu.ops.activations import ACT2FN
+from llm_np_cp_tpu.ops.attention import causal_mask
+from llm_np_cp_tpu.ops.rope import rope_cos_sin
+from llm_np_cp_tpu.parallel.sharding import PIPE_AXIS, MeshPlan
+
+Params = dict[str, Any]
+
+
+def _stage_schedule(
+    local_layers: Params,
+    local_sliding: jnp.ndarray,
+    x_mb: jnp.ndarray,
+    cos: jnp.ndarray,
+    sin: jnp.ndarray,
+    mask_global: jnp.ndarray,
+    mask_local: jnp.ndarray,
+    *,
+    config: ModelConfig,
+    num_stages: int,
+) -> jnp.ndarray:
+    """Per-device body (runs inside shard_map, manual over "pipe").
+
+    local_layers: this stage's ``[L/P, ...]`` weight block.
+    x_mb: ``[M, mb, S, H]`` microbatched embeddings (replicated over pipe;
+        only stage 0 reads them).
+    cos/sin/masks: shared by every microbatch (uniform positions 0..S−1 —
+        ragged batches are a cached-decode feature, out of PP scope).
+
+    Returns ``[M, mb, S, H]`` final hidden states, replicated over "pipe".
+    """
+    idx = lax.axis_index(PIPE_AXIS)
+    num_micro = x_mb.shape[0]
+    act = ACT2FN[config.hidden_act]
+
+    def local_block(x: jnp.ndarray, ws: tuple) -> tuple[jnp.ndarray, None]:
+        w, sliding = ws
+        x, _, _ = run_decoder_layer(
+            w, x, config=config, act=act, cos=cos, sin=sin,
+            mask_global=mask_global, mask_local=mask_local, sliding=sliding,
+        )
+        return x, None
+
+    def step(carry: tuple, t: jnp.ndarray) -> tuple[tuple, None]:
+        ring_in, out = carry
+        # stage 0 ingests microbatch t; later stages take the ring input
+        x_in = jnp.where(
+            idx == 0, x_mb[jnp.clip(t, 0, num_micro - 1)], ring_in
+        )
+        y, _ = lax.scan(local_block, x_in, (local_layers, local_sliding))
+        # the last stage finishes microbatch t−(P−1) at step t
+        done = t - (num_stages - 1)
+        oi = jnp.clip(done, 0, num_micro - 1)
+        prev = lax.dynamic_index_in_dim(out, oi, 0, keepdims=False)
+        val = jnp.where((idx == num_stages - 1) & (done >= 0), y, prev)
+        out = lax.dynamic_update_index_in_dim(out, val, oi, 0)
+        ring_out = lax.ppermute(
+            y, PIPE_AXIS, [(i, (i + 1) % num_stages) for i in range(num_stages)]
+        )
+        return (ring_out, out), None
+
+    steps = jnp.arange(num_micro + num_stages - 1)
+    # the carries become pipe-varying on the first step (idx enters the
+    # where); mark the zero inits varying so scan's carry types are stable
+    ring0 = lax.pcast(jnp.zeros_like(x_mb[0]), (PIPE_AXIS,), to="varying")
+    out0 = lax.pcast(jnp.zeros_like(x_mb), (PIPE_AXIS,), to="varying")
+    (_, out), _ = lax.scan(step, (ring0, out0), steps)
+    # broadcast the last stage's accumulator to every stage
+    return lax.psum(
+        jnp.where(idx == num_stages - 1, out, jnp.zeros_like(out)), PIPE_AXIS
+    )
+
+
+def pp_forward(
+    params: Params,
+    input_ids: jnp.ndarray,
+    config: ModelConfig,
+    plan: MeshPlan,
+    mesh: Mesh,
+    *,
+    num_microbatches: int,
+    logits_last_only: bool = False,
+) -> jnp.ndarray:
+    """Cache-less forward with the layer stack pipelined over "pipe".
+
+    input_ids: [B, S]; B must divide into ``num_microbatches`` equal
+    microbatches (the microbatch is the pipeline's unit of work — more
+    microbatches shrink the P−1-step bubble at the cost of smaller GEMMs).
+
+    Returns logits [B, S, V] float32 (or [B, 1, V] when logits_last_only),
+    numerically identical to ``models.transformer.forward`` with no cache.
+    """
+    b, s = input_ids.shape
+    num_stages = plan.pipe
+    if config.num_hidden_layers % num_stages:
+        raise ValueError(
+            f"num_hidden_layers={config.num_hidden_layers} not divisible by "
+            f"pipe={num_stages}"
+        )
+    if b % num_microbatches:
+        raise ValueError(f"batch {b} not divisible by microbatches {num_microbatches}")
+    mb = b // num_microbatches
+
+    x = embed_inputs(params, input_ids, config)
+
+    positions = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32)[None, :], (mb, s))
+    cos, sin = rope_cos_sin(positions, config, dtype=jnp.float32)
+    mask_global = causal_mask(positions, positions)
+    mask_local = (
+        causal_mask(positions, positions, window=config.sliding_window)
+        if config.sliding_window is not None
+        else mask_global
+    )
+    is_sliding = jnp.array(
+        [config.layer_is_sliding(i) for i in range(config.num_hidden_layers)],
+        dtype=jnp.bool_,
+    )
+
+    x_mb = x.reshape(num_microbatches, mb, s, x.shape[-1])
+    staged = jax.shard_map(
+        partial(_stage_schedule, config=config, num_stages=num_stages),
+        mesh=mesh,
+        axis_names={PIPE_AXIS},
+        in_specs=(P(PIPE_AXIS), P(PIPE_AXIS), P(), P(), P(), P(), P()),
+        out_specs=P(),
+    )
+    out = staged(params["layers"], is_sliding, x_mb, cos, sin, mask_global, mask_local)
+    hidden = out.reshape(b, s, x.shape[-1])
+    return final_logits(params, hidden, config, last_only=logits_last_only)
+
+
+def make_pp_loss_fn(
+    config: ModelConfig, plan: MeshPlan, mesh: Mesh, *, num_microbatches: int
+):
+    """Pipelined causal-LM loss — same math as train.causal_lm_loss."""
+
+    def loss_fn(
+        params: Params, batch: jnp.ndarray, loss_mask: jnp.ndarray | None = None
+    ) -> jnp.ndarray:
+        inputs, targets = batch[:, :-1], batch[:, 1:]
+        logits = pp_forward(
+            params, inputs, config, plan, mesh, num_microbatches=num_microbatches
+        )
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+        if loss_mask is not None:
+            return jnp.sum(nll * loss_mask) / jnp.maximum(jnp.sum(loss_mask), 1.0)
+        return jnp.mean(nll)
+
+    return loss_fn
+
+
+def make_pp_train_step(
+    config: ModelConfig,
+    optimizer: optax.GradientTransformation,
+    plan: MeshPlan,
+    mesh: Mesh,
+    *,
+    num_microbatches: int,
+):
+    """Jitted pipelined ``step(params, opt_state, batch) → (params,
+    opt_state, loss)``.  Gradients flow backward through the ppermute ring
+    (exact GPipe); optimizer update happens where each shard lives."""
+    loss_fn = make_pp_loss_fn(config, plan, mesh, num_microbatches=num_microbatches)
+
+    @jax.jit
+    def step(params: Params, opt_state, batch: jnp.ndarray):
+        loss, grads = jax.value_and_grad(loss_fn)(params, batch)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    return step

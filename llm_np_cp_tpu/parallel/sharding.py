@@ -40,6 +40,7 @@ from llm_np_cp_tpu.config import ModelConfig
 DATA_AXIS = "data"
 MODEL_AXIS = "model"
 SEQ_AXIS = "seq"
+PIPE_AXIS = "pipe"
 
 
 @dataclasses.dataclass(frozen=True)
@@ -47,16 +48,19 @@ class MeshPlan:
     """Static parallelism plan: how many ways each mesh axis is split.
 
     data: batch sharding (DP); model: tensor parallelism (TP);
-    seq: sequence/context parallelism for the KV cache and ring attention.
+    seq: sequence/context parallelism for the KV cache and ring attention;
+    pipe: pipeline parallelism over the stacked layer axis (GPipe schedule,
+    parallel/pipeline.py — training/no-cache forward only).
     """
 
     data: int = 1
     model: int = 1
     seq: int = 1
+    pipe: int = 1
 
     @property
     def num_devices(self) -> int:
-        return self.data * self.model * self.seq
+        return self.data * self.model * self.seq * self.pipe
 
     def validate(self, config: ModelConfig) -> None:
         if self.model > 1:
@@ -69,6 +73,11 @@ class MeshPlan:
                     raise ValueError(
                         f"{name}={dim} not divisible by model={self.model}"
                     )
+        if self.pipe > 1 and config.num_hidden_layers % self.pipe != 0:
+            raise ValueError(
+                f"num_hidden_layers={config.num_hidden_layers} not divisible "
+                f"by pipe={self.pipe}"
+            )
 
 
 def make_mesh(plan: MeshPlan, devices: list | None = None) -> Mesh:
@@ -76,8 +85,10 @@ def make_mesh(plan: MeshPlan, devices: list | None = None) -> Mesh:
     n = plan.num_devices
     if n > len(devices):
         raise ValueError(f"plan needs {n} devices, have {len(devices)}")
-    grid = np.asarray(devices[:n]).reshape(plan.data, plan.seq, plan.model)
-    return Mesh(grid, (DATA_AXIS, SEQ_AXIS, MODEL_AXIS))
+    grid = np.asarray(devices[:n]).reshape(
+        plan.data, plan.pipe, plan.seq, plan.model
+    )
+    return Mesh(grid, (DATA_AXIS, PIPE_AXIS, SEQ_AXIS, MODEL_AXIS))
 
 
 def _kv_heads_shardable(config: ModelConfig, plan: MeshPlan) -> bool:
@@ -87,25 +98,28 @@ def _kv_heads_shardable(config: ModelConfig, plan: MeshPlan) -> bool:
 def param_specs(config: ModelConfig, plan: MeshPlan) -> dict[str, Any]:
     """PartitionSpec pytree matching models.transformer.param_shapes.
 
-    Leading layer axis of stacked weights is never sharded (lax.scan
-    consumes it).
+    The leading layer axis of stacked weights is sharded over "pipe" when
+    pipeline parallelism is on (parallel/pipeline.py consumes the local
+    block per stage); under plain ``forward`` (pipe=1) it stays unsharded
+    (lax.scan consumes it).
     """
     m = MODEL_AXIS if plan.model > 1 else None
     kv = MODEL_AXIS if _kv_heads_shardable(config, plan) else None
+    pp = PIPE_AXIS if plan.pipe > 1 else None
     layers = {
-        "ln_attn_in": P(None, None),
-        "q_proj": P(None, None, m),
-        "k_proj": P(None, None, kv),
-        "v_proj": P(None, None, kv),
-        "o_proj": P(None, m, None),
-        "ln_mlp_in": P(None, None),
-        "gate_proj": P(None, None, m),
-        "up_proj": P(None, None, m),
-        "down_proj": P(None, m, None),
+        "ln_attn_in": P(pp, None),
+        "q_proj": P(pp, None, m),
+        "k_proj": P(pp, None, kv),
+        "v_proj": P(pp, None, kv),
+        "o_proj": P(pp, m, None),
+        "ln_mlp_in": P(pp, None),
+        "gate_proj": P(pp, None, m),
+        "up_proj": P(pp, None, m),
+        "down_proj": P(pp, m, None),
     }
     if config.sandwich_norms:
-        layers["ln_attn_out"] = P(None, None)
-        layers["ln_mlp_out"] = P(None, None)
+        layers["ln_attn_out"] = P(pp, None)
+        layers["ln_mlp_out"] = P(pp, None)
     specs: dict[str, Any] = {
         "embed_tokens": P(m, None),
         "layers": layers,

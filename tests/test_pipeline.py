@@ -1,0 +1,104 @@
+"""Pipeline parallelism: pipelined forward/loss/gradients must match the
+plain (lax.scan) path exactly — the pipeline is a schedule, not a model
+change.  Runs on the forced 8-CPU-device mesh (conftest)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from llm_np_cp_tpu.config import tiny_config
+from llm_np_cp_tpu.models.transformer import forward, init_params
+from llm_np_cp_tpu.parallel.pipeline import (
+    make_pp_loss_fn,
+    make_pp_train_step,
+    pp_forward,
+)
+from llm_np_cp_tpu.parallel.sharding import (
+    MeshPlan,
+    batch_spec,
+    make_mesh,
+    shard_params,
+    to_shardings,
+)
+from llm_np_cp_tpu.train import causal_lm_loss, default_optimizer
+
+
+def _setup(model_type, plan, *, num_layers=4, seed=0):
+    cfg = tiny_config(
+        model_type,
+        num_hidden_layers=num_layers,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        head_dim=8,
+        hidden_size=32,
+        intermediate_size=64,
+    )
+    plan.validate(cfg)
+    mesh = make_mesh(plan)
+    params = init_params(jax.random.PRNGKey(seed), cfg, dtype=jnp.float32)
+    sharded = shard_params(params, cfg, plan, mesh)
+    return cfg, mesh, params, sharded
+
+
+@pytest.mark.parametrize("model_type", ["llama", "gemma2"])
+def test_pp_forward_matches_plain(model_type):
+    plan = MeshPlan(data=2, model=2, pipe=2)
+    cfg, mesh, params, sharded = _setup(model_type, plan)
+    ids = jnp.asarray(
+        np.random.default_rng(0).integers(0, cfg.vocab_size, (4, 12)), jnp.int32
+    )
+    ref, _ = forward(params, ids, cfg, None)
+    got = pp_forward(sharded, ids, cfg, plan, mesh, num_microbatches=2)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), atol=2e-4)
+
+
+def test_pp_loss_and_grads_match_plain():
+    plan = MeshPlan(data=1, model=2, pipe=4)
+    cfg, mesh, params, sharded = _setup("llama", plan)
+    batch = jnp.asarray(
+        np.random.default_rng(1).integers(0, cfg.vocab_size, (4, 16)), jnp.int32
+    )
+    loss_fn = make_pp_loss_fn(cfg, plan, mesh, num_microbatches=4)
+
+    ref_loss, ref_grads = jax.value_and_grad(causal_lm_loss)(params, batch, cfg)
+    pp_loss, pp_grads = jax.value_and_grad(loss_fn)(sharded, batch)
+
+    np.testing.assert_allclose(float(pp_loss), float(ref_loss), rtol=1e-5)
+    flat_ref = jax.tree.leaves_with_path(ref_grads)
+    flat_pp = dict(
+        (jax.tree_util.keystr(k), v) for k, v in jax.tree.leaves_with_path(pp_grads)
+    )
+    for k, v in flat_ref:
+        np.testing.assert_allclose(
+            np.asarray(flat_pp[jax.tree_util.keystr(k)]),
+            np.asarray(v),
+            atol=1e-4,
+            err_msg=jax.tree_util.keystr(k),
+        )
+
+
+def test_pp_train_step_runs_and_improves():
+    plan = MeshPlan(data=2, pipe=2)
+    cfg, mesh, _, sharded = _setup("llama", plan)
+    opt = default_optimizer(1e-2)
+    opt_state = opt.init(sharded)
+    step = make_pp_train_step(cfg, opt, plan, mesh, num_microbatches=2)
+    batch = jax.device_put(
+        jnp.asarray(
+            np.random.default_rng(2).integers(0, cfg.vocab_size, (4, 16)), jnp.int32
+        ),
+        to_shardings(mesh, batch_spec(plan)),
+    )
+    params, opt_state, loss0 = step(sharded, opt_state, batch)
+    for _ in range(4):
+        params, opt_state, loss = step(params, opt_state, batch)
+    assert np.isfinite(float(loss0))
+    assert float(loss) < float(loss0)
+
+
+def test_pp_validates_divisibility():
+    plan = MeshPlan(pipe=3)
+    cfg = tiny_config("llama", num_hidden_layers=4)
+    with pytest.raises(ValueError, match="not divisible"):
+        plan.validate(cfg)
