@@ -77,6 +77,15 @@ class ModelConfig:
     # schedule, config key `cache_implementation: hybrid`, gemma2_model.py:104).
     query_pre_attn_scalar: float | None = None
 
+    # --- Mixture-of-Experts (framework extension; neither reference family
+    # is MoE — SURVEY §2.9 lists EP as N/A — but the framework supports
+    # Mixtral-style sparse MLPs so expert parallelism has a real workload).
+    num_local_experts: int | None = None
+    num_experts_per_tok: int = 2
+    moe_capacity_factor: float = 2.0  # per-expert buffer = gs*k/E * this
+    moe_group_size: int = 1024  # GShard token-group length (keeps dispatch linear in T)
+    router_aux_loss_coef: float = 0.02
+
     def __post_init__(self) -> None:
         # Note: hidden_size need not equal heads*head_dim (Gemma-2-2B:
         # 2304 hidden, 8 heads of 256), so no divisibility constraint there.
@@ -87,6 +96,10 @@ class ModelConfig:
             )
 
     # ------------------------------------------------------------------
+    @property
+    def is_moe(self) -> bool:
+        return self.num_local_experts is not None
+
     @property
     def num_query_groups(self) -> int:
         return self.num_attention_heads // self.num_key_value_heads
@@ -135,6 +148,12 @@ class ModelConfig:
             attention_bias=d.get("attention_bias", False),
             mlp_bias=d.get("mlp_bias", False),
         )
+        if d.get("num_local_experts"):
+            kwargs.update(
+                num_local_experts=d["num_local_experts"],
+                num_experts_per_tok=d.get("num_experts_per_tok", 2),
+                router_aux_loss_coef=d.get("router_aux_loss_coef", 0.02),
+            )
         rope_scaling = d.get("rope_scaling") or None
         if rope_scaling and rope_scaling.get("rope_type", rope_scaling.get("type")) == "llama3":
             kwargs.update(

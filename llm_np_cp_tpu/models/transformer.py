@@ -63,10 +63,21 @@ def param_shapes(config: ModelConfig) -> dict[str, Any]:
         "v_proj": (L, H, NK * D),
         "o_proj": (L, NH * D, H),
         "ln_mlp_in": (L, H),
-        "gate_proj": (L, H, I),
-        "up_proj": (L, H, I),
-        "down_proj": (L, I, H),
     }
+    if config.is_moe:
+        E = config.num_local_experts
+        layers.update(
+            router=(L, H, E),
+            gate_proj=(L, E, H, I),
+            up_proj=(L, E, H, I),
+            down_proj=(L, E, I, H),
+        )
+    else:
+        layers.update(
+            gate_proj=(L, H, I),
+            up_proj=(L, H, I),
+            down_proj=(L, I, H),
+        )
     if config.sandwich_norms:
         layers["ln_attn_out"] = (L, H)
         layers["ln_mlp_out"] = (L, H)
@@ -165,7 +176,12 @@ def run_decoder_layer(
     attn_impl: str = "xla",
     kv_update: Any = None,
     output_attentions: bool = False,
-) -> tuple[jnp.ndarray, tuple[jnp.ndarray, jnp.ndarray], jnp.ndarray | None]:
+) -> tuple[
+    jnp.ndarray,
+    tuple[jnp.ndarray, jnp.ndarray],
+    jnp.ndarray | None,
+    jnp.ndarray,
+]:
     """One decoder block (pre-norm or Gemma sandwich-norm residual).
 
     w: one layer's weight dict (un-stacked leaves).
@@ -175,9 +191,10 @@ def run_decoder_layer(
     sliding: traced bool — selects ``mask_local`` (and the flash kernel's
         window) for Gemma-2's alternating local layers.
 
-    Returns ``(x_out, (k_att, v_att), attn_weights | None)``.  Shared by
-    ``forward``'s lax.scan and the pipeline-parallel schedule
-    (parallel/pipeline.py), so both trace identical layer math.
+    Returns ``(x_out, (k_att, v_att), attn_weights | None, moe_aux_loss)``
+    (aux loss is 0.0 for dense layers).  Shared by ``forward``'s lax.scan
+    and the pipeline-parallel schedule (parallel/pipeline.py), so both
+    trace identical layer math.
     """
     mask = (
         jnp.where(sliding, mask_local, mask_global)
@@ -241,16 +258,27 @@ def run_decoder_layer(
         x, w["ln_mlp_in"], eps=config.rms_norm_eps,
         unit_offset=config.rms_norm_unit_offset,
     )
-    gate = act(_project(h, w["gate_proj"]))
-    up = _project(h, w["up_proj"])
-    mlp = _project(gate * up, w["down_proj"])
+    moe_aux = jnp.zeros((), jnp.float32)
+    if config.is_moe:
+        from llm_np_cp_tpu.ops.moe import moe_mlp
+
+        mlp, moe_aux = moe_mlp(
+            h, w["router"], w["gate_proj"], w["up_proj"], w["down_proj"],
+            act=act, top_k=config.num_experts_per_tok,
+            capacity_factor=config.moe_capacity_factor,
+            group_size=config.moe_group_size,
+        )
+    else:
+        gate = act(_project(h, w["gate_proj"]))
+        up = _project(h, w["up_proj"])
+        mlp = _project(gate * up, w["down_proj"])
     if config.sandwich_norms:
         mlp = rms_norm(
             mlp, w["ln_mlp_out"], eps=config.rms_norm_eps,
             unit_offset=config.rms_norm_unit_offset,
         )
     x = x + mlp
-    return x, (k_att, v_att), attn_weights
+    return x, (k_att, v_att), attn_weights, moe_aux
 
 
 def forward(
@@ -265,6 +293,7 @@ def forward(
     logits_last_only: bool = False,
     output_hidden_states: bool = False,
     output_attentions: bool = False,
+    output_router_losses: bool = False,
     attn_impl: str = "xla",
 ) -> tuple:
     """Run the decoder.
@@ -382,7 +411,7 @@ def forward(
             if cache is not None
             else None
         )
-        x, kv_att, attn_weights = run_decoder_layer(
+        x, kv_att, attn_weights, moe_aux = run_decoder_layer(
             w, x, config=config, act=act, cos=cos, sin=sin,
             mask_global=mask_global, mask_local=mask_local,
             sliding=sliding, attn_impl=attn_impl, kv_update=kv_update,
@@ -391,7 +420,7 @@ def forward(
         if cache is not None:
             k_l, v_l = kv_att  # updated cache slabs (flash also writes them)
 
-        ys: tuple = (k_l, v_l)
+        ys: tuple = (k_l, v_l, moe_aux)
         if output_hidden_states:
             ys += (x_in,)
         if output_attentions:
@@ -401,7 +430,9 @@ def forward(
     x, scan_out = lax.scan(layer_step, x, (lp, k_cache, v_cache, is_sliding))
     new_k, new_v = scan_out[0], scan_out[1]
     aux: dict[str, jnp.ndarray] = {}
-    pos_idx = 2
+    if config.is_moe and output_router_losses:
+        aux["moe_aux_loss"] = jnp.mean(scan_out[2])  # mean over layers
+    pos_idx = 3
     if output_hidden_states:
         aux["hidden_states"] = scan_out[pos_idx]  # [L, B, S, H] layer inputs
         pos_idx += 1
@@ -418,8 +449,12 @@ def forward(
 
     if output_hidden_states:
         # final normed output appended (reference collects it after the
-        # final norm too, llama3.2_model.py:708-713)
-        aux["final_hidden_state"] = x
+        # final norm too, llama3.2_model.py:708-713); the same rms_norm is
+        # traced inside final_logits — XLA CSEs the duplicate
+        aux["final_hidden_state"] = rms_norm(
+            x, params["final_norm"], eps=config.rms_norm_eps,
+            unit_offset=config.rms_norm_unit_offset,
+        )
     if aux:
         return logits, new_cache, aux
     return logits, new_cache

@@ -1,0 +1,114 @@
+"""Sparse Mixture-of-Experts MLP (Mixtral-style top-k routing).
+
+Framework extension: neither reference family is MoE (SURVEY §2.9 lists
+expert parallelism as N/A), but a real EP workload needs a real sparse
+layer.  The design is the TPU-native dispatch/combine formulation
+(GShard lineage): routing becomes two einsums against a one-hot dispatch
+tensor, so the whole layer is static-shaped, differentiable, and GSPMD
+shards it by annotating the expert axis — the compiler inserts the
+all-to-all-equivalent collectives, no hand-written routing backend.
+
+Tokens are processed in *groups* of ≤ ``group_size`` (the GShard group
+dimension): the dispatch tensor is ``[G, gs, E, C]`` with per-group
+capacity ``C = ceil(gs · k / E · capacity_factor)``, so its size stays
+linear in the token count instead of the quadratic blow-up a single
+global dispatch tensor would have.
+
+Capacity semantics: each expert owns ``C`` slots per group.  Tokens that
+overflow an expert's buffer are *dropped* for that expert (their combine
+weight is zero) and pass through the residual unchanged — standard
+GShard/Switch behavior, and the price of static shapes under jit.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def _group_split(t: int, group_size: int) -> int:
+    """Largest divisor of t that is ≤ group_size (group length gs; G=t/gs)."""
+    gs = min(t, group_size)
+    while t % gs:
+        gs -= 1
+    return gs
+
+
+def moe_mlp(
+    x: jnp.ndarray,
+    router_w: jnp.ndarray,
+    gate_w: jnp.ndarray,
+    up_w: jnp.ndarray,
+    down_w: jnp.ndarray,
+    *,
+    act,
+    top_k: int,
+    capacity_factor: float = 2.0,
+    group_size: int = 1024,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Top-k routed SwiGLU experts.
+
+    x: [B, S, H]; router_w: [H, E]; gate_w/up_w: [E, H, I]; down_w: [E, I, H].
+
+    Returns ``(out [B, S, H], aux_loss scalar)`` where aux_loss is the
+    load-balancing loss ``E · Σ_e f_e · P_e`` (f_e = fraction of token
+    routes sent to expert e, P_e = mean router probability, both over the
+    full token set) — the standard Switch/Mixtral auxiliary, ~1 when
+    perfectly balanced.
+    """
+    b, s, h = x.shape
+    e = router_w.shape[-1]
+    t = b * s
+    xt = x.reshape(t, h)
+
+    # Routing in f32 (tiny GEMM; numerics matter more than speed here).
+    router_logits = jnp.einsum(
+        "th,he->te", xt.astype(jnp.float32), router_w.astype(jnp.float32)
+    )
+    probs = jax.nn.softmax(router_logits, axis=-1)  # [T, E]
+    top_vals, top_idx = lax.top_k(probs, top_k)  # [T, k]
+    top_vals = top_vals / jnp.sum(top_vals, axis=-1, keepdims=True)  # renorm (Mixtral)
+    # gates: [T, E] — renormalized prob on chosen experts, 0 elsewhere
+    gates = jnp.zeros_like(probs).at[jnp.arange(t)[:, None], top_idx].set(top_vals)
+    routed = gates > 0.0
+
+    # Group tokens; static per-expert capacity per group.
+    gs = _group_split(t, group_size)
+    g = t // gs
+    capacity = max(1, math.ceil(gs * top_k / e * capacity_factor))
+    routed_g = routed.reshape(g, gs, e)
+    position = jnp.cumsum(routed_g.astype(jnp.int32), axis=1) - 1  # [G, gs, E]
+    keep = routed_g & (position < capacity)
+    # one_hot of -1 is the zero row → dropped tokens vanish from dispatch
+    dispatch = jax.nn.one_hot(
+        jnp.where(keep, position, -1), capacity, dtype=x.dtype
+    )  # [G, gs, E, C]
+
+    xg = xt.reshape(g, gs, h)
+    expert_in = jnp.einsum(
+        "gtec,gth->gech", dispatch, xg, preferred_element_type=jnp.float32
+    ).astype(x.dtype)
+    gate_h = act(
+        jnp.einsum("gech,ehi->geci", expert_in, gate_w, preferred_element_type=jnp.float32)
+    ).astype(x.dtype)
+    up_h = jnp.einsum(
+        "gech,ehi->geci", expert_in, up_w, preferred_element_type=jnp.float32
+    ).astype(x.dtype)
+    expert_out = jnp.einsum(
+        "geci,eih->gech", gate_h * up_h, down_w, preferred_element_type=jnp.float32
+    ).astype(x.dtype)
+
+    combine = dispatch * gates.reshape(g, gs, e).astype(x.dtype)[..., None]
+    out = jnp.einsum(
+        "gtec,gech->gth", combine, expert_out, preferred_element_type=jnp.float32
+    ).astype(x.dtype)
+
+    # Load-balancing auxiliary (f32): fraction of routes per expert × mean prob.
+    route_frac = jnp.mean(routed.astype(jnp.float32), axis=0) / top_k  # [E]
+    prob_frac = jnp.mean(probs, axis=0)  # [E]
+    aux_loss = e * jnp.sum(route_frac * prob_frac)
+
+    return out.reshape(b, s, h), aux_loss

@@ -75,27 +75,36 @@ def _stage_schedule(
     cos/sin/masks: shared by every microbatch (uniform positions 0..S−1 —
         ragged batches are a cached-decode feature, out of PP scope).
 
-    Returns ``[M, mb, S, H]`` final hidden states, replicated over "pipe".
+    Returns ``([M, mb, S, H] final hidden states, moe_aux scalar)``, both
+    replicated over "pipe".  The router aux loss is averaged over
+    (layer, microbatch) pairs — per-microbatch balancing, the GShard
+    per-group convention (it differs from the full-batch statistic the
+    unpipelined path computes only through routing-fraction covariance
+    across microbatches).
     """
     idx = lax.axis_index(PIPE_AXIS)
     num_micro = x_mb.shape[0]
     act = ACT2FN[config.hidden_act]
 
-    def local_block(x: jnp.ndarray, ws: tuple) -> tuple[jnp.ndarray, None]:
+    def local_block(x: jnp.ndarray, ws: tuple) -> tuple[jnp.ndarray, jnp.ndarray]:
         w, sliding = ws
-        x, _, _ = run_decoder_layer(
+        x, _, _, moe_aux = run_decoder_layer(
             w, x, config=config, act=act, cos=cos, sin=sin,
             mask_global=mask_global, mask_local=mask_local, sliding=sliding,
         )
-        return x, None
+        return x, moe_aux
 
     def step(carry: tuple, t: jnp.ndarray) -> tuple[tuple, None]:
-        ring_in, out = carry
+        ring_in, out, aux_sum = carry
         # stage 0 ingests microbatch t; later stages take the ring input
         x_in = jnp.where(
             idx == 0, x_mb[jnp.clip(t, 0, num_micro - 1)], ring_in
         )
-        y, _ = lax.scan(local_block, x_in, (local_layers, local_sliding))
+        y, layer_aux = lax.scan(local_block, x_in, (local_layers, local_sliding))
+        # stage p holds microbatch t−p; bubbles (outside [0, M)) are garbage
+        # and must not pollute the router-loss accumulator
+        real = (t >= idx) & (t - idx < num_micro)
+        aux_sum = aux_sum + jnp.where(real, jnp.sum(layer_aux), 0.0)
         # the last stage finishes microbatch t−(P−1) at step t
         done = t - (num_stages - 1)
         oi = jnp.clip(done, 0, num_micro - 1)
@@ -105,18 +114,25 @@ def _stage_schedule(
         ring_out = lax.ppermute(
             y, PIPE_AXIS, [(i, (i + 1) % num_stages) for i in range(num_stages)]
         )
-        return (ring_out, out), None
+        return (ring_out, out, aux_sum), None
 
     steps = jnp.arange(num_micro + num_stages - 1)
     # the carries become pipe-varying on the first step (idx enters the
     # where); mark the zero inits varying so scan's carry types are stable
-    ring0 = lax.pcast(jnp.zeros_like(x_mb[0]), (PIPE_AXIS,), to="varying")
-    out0 = lax.pcast(jnp.zeros_like(x_mb), (PIPE_AXIS,), to="varying")
-    (_, out), _ = lax.scan(step, (ring0, out0), steps)
-    # broadcast the last stage's accumulator to every stage
-    return lax.psum(
+    varying = lambda a: lax.pcast(a, (PIPE_AXIS,), to="varying")
+    ring0 = varying(jnp.zeros_like(x_mb[0]))
+    out0 = varying(jnp.zeros_like(x_mb))
+    aux0 = varying(jnp.zeros((), jnp.float32))
+    (_, out, aux_sum), _ = lax.scan(step, (ring0, out0, aux0), steps)
+    # broadcast the last stage's accumulator to every stage; mean the
+    # router loss over all (layer, microbatch) pairs across stages
+    out = lax.psum(
         jnp.where(idx == num_stages - 1, out, jnp.zeros_like(out)), PIPE_AXIS
     )
+    moe_aux = lax.psum(aux_sum, PIPE_AXIS) / (
+        config.num_hidden_layers * num_micro
+    )
+    return out, moe_aux
 
 
 def pp_forward(
@@ -128,7 +144,8 @@ def pp_forward(
     *,
     num_microbatches: int,
     logits_last_only: bool = False,
-) -> jnp.ndarray:
+    output_router_losses: bool = False,
+) -> jnp.ndarray | tuple[jnp.ndarray, jnp.ndarray]:
     """Cache-less forward with the layer stack pipelined over "pipe".
 
     input_ids: [B, S]; B must divide into ``num_microbatches`` equal
@@ -136,7 +153,9 @@ def pp_forward(
     microbatches shrink the P−1-step bubble at the cost of smaller GEMMs).
 
     Returns logits [B, S, V] float32 (or [B, 1, V] when logits_last_only),
-    numerically identical to ``models.transformer.forward`` with no cache.
+    numerically identical to ``models.transformer.forward`` with no cache;
+    with ``output_router_losses`` also the MoE aux-loss scalar (averaged
+    per microbatch — see _stage_schedule).
     """
     b, s = input_ids.shape
     num_stages = plan.pipe
@@ -170,30 +189,41 @@ def pp_forward(
         mesh=mesh,
         axis_names={PIPE_AXIS},
         in_specs=(P(PIPE_AXIS), P(PIPE_AXIS), P(), P(), P(), P(), P()),
-        out_specs=P(),
+        out_specs=(P(), P()),
     )
-    out = staged(params["layers"], is_sliding, x_mb, cos, sin, mask_global, mask_local)
+    out, moe_aux = staged(
+        params["layers"], is_sliding, x_mb, cos, sin, mask_global, mask_local
+    )
     hidden = out.reshape(b, s, x.shape[-1])
-    return final_logits(params, hidden, config, last_only=logits_last_only)
+    logits = final_logits(params, hidden, config, last_only=logits_last_only)
+    if output_router_losses:
+        return logits, moe_aux
+    return logits
 
 
 def make_pp_loss_fn(
     config: ModelConfig, plan: MeshPlan, mesh: Mesh, *, num_microbatches: int
 ):
-    """Pipelined causal-LM loss — same math as train.causal_lm_loss."""
+    """Pipelined causal-LM loss — same math as train.causal_lm_loss (the
+    MoE router aux loss is included with its per-microbatch semantics)."""
 
     def loss_fn(
         params: Params, batch: jnp.ndarray, loss_mask: jnp.ndarray | None = None
     ) -> jnp.ndarray:
         inputs, targets = batch[:, :-1], batch[:, 1:]
-        logits = pp_forward(
-            params, inputs, config, plan, mesh, num_microbatches=num_microbatches
+        logits, moe_aux = pp_forward(
+            params, inputs, config, plan, mesh,
+            num_microbatches=num_microbatches, output_router_losses=True,
         )
         logp = jax.nn.log_softmax(logits, axis=-1)
         nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
         if loss_mask is not None:
-            return jnp.sum(nll * loss_mask) / jnp.maximum(jnp.sum(loss_mask), 1.0)
-        return jnp.mean(nll)
+            loss = jnp.sum(nll * loss_mask) / jnp.maximum(jnp.sum(loss_mask), 1.0)
+        else:
+            loss = jnp.mean(nll)
+        if config.is_moe:
+            loss = loss + config.router_aux_loss_coef * moe_aux
+        return loss
 
     return loss_fn
 

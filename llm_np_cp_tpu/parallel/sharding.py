@@ -41,6 +41,7 @@ DATA_AXIS = "data"
 MODEL_AXIS = "model"
 SEQ_AXIS = "seq"
 PIPE_AXIS = "pipe"
+EXPERT_AXIS = "expert"
 
 
 @dataclasses.dataclass(frozen=True)
@@ -50,17 +51,21 @@ class MeshPlan:
     data: batch sharding (DP); model: tensor parallelism (TP);
     seq: sequence/context parallelism for the KV cache and ring attention;
     pipe: pipeline parallelism over the stacked layer axis (GPipe schedule,
-    parallel/pipeline.py — training/no-cache forward only).
+    parallel/pipeline.py — training/no-cache forward only);
+    expert: expert parallelism for MoE configs (ops/moe.py — the expert
+    axis of router dispatch/combine einsums; GSPMD inserts the
+    all-to-all-equivalent collectives).
     """
 
     data: int = 1
     model: int = 1
     seq: int = 1
     pipe: int = 1
+    expert: int = 1
 
     @property
     def num_devices(self) -> int:
-        return self.data * self.model * self.seq * self.pipe
+        return self.data * self.model * self.seq * self.pipe * self.expert
 
     def validate(self, config: ModelConfig) -> None:
         if self.model > 1:
@@ -78,6 +83,14 @@ class MeshPlan:
                 f"num_hidden_layers={config.num_hidden_layers} not divisible "
                 f"by pipe={self.pipe}"
             )
+        if self.expert > 1:
+            if not config.is_moe:
+                raise ValueError("expert>1 requires a MoE config")
+            if config.num_local_experts % self.expert != 0:
+                raise ValueError(
+                    f"num_local_experts={config.num_local_experts} not "
+                    f"divisible by expert={self.expert}"
+                )
 
 
 def make_mesh(plan: MeshPlan, devices: list | None = None) -> Mesh:
@@ -86,9 +99,9 @@ def make_mesh(plan: MeshPlan, devices: list | None = None) -> Mesh:
     if n > len(devices):
         raise ValueError(f"plan needs {n} devices, have {len(devices)}")
     grid = np.asarray(devices[:n]).reshape(
-        plan.data, plan.pipe, plan.seq, plan.model
+        plan.data, plan.pipe, plan.seq, plan.expert, plan.model
     )
-    return Mesh(grid, (DATA_AXIS, PIPE_AXIS, SEQ_AXIS, MODEL_AXIS))
+    return Mesh(grid, (DATA_AXIS, PIPE_AXIS, SEQ_AXIS, EXPERT_AXIS, MODEL_AXIS))
 
 
 def _kv_heads_shardable(config: ModelConfig, plan: MeshPlan) -> bool:
@@ -117,6 +130,14 @@ def param_specs(config: ModelConfig, plan: MeshPlan) -> dict[str, Any]:
         "up_proj": P(pp, None, m),
         "down_proj": P(pp, m, None),
     }
+    if config.is_moe:
+        # expert weights [L, E, ...]: experts on "expert", feature dims on
+        # "model" (EP × TP compose); the tiny router stays replicated
+        ex = EXPERT_AXIS if plan.expert > 1 else None
+        layers["router"] = P(pp, None, None)
+        layers["gate_proj"] = P(pp, ex, None, m)
+        layers["up_proj"] = P(pp, ex, None, m)
+        layers["down_proj"] = P(pp, ex, m, None)
     if config.sandwich_norms:
         layers["ln_attn_out"] = P(pp, None)
         layers["ln_mlp_out"] = P(pp, None)

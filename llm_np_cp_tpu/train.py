@@ -31,15 +31,23 @@ def causal_lm_loss(
     loss_mask: jnp.ndarray | None = None,
 ) -> jnp.ndarray:
     """Mean next-token cross-entropy.  batch: [B, S] int32; positions
-    t < S-1 predict t+1.  loss_mask: optional [B, S-1] weighting."""
+    t < S-1 predict t+1.  loss_mask: optional [B, S-1] weighting.
+    MoE configs add ``router_aux_loss_coef ×`` the load-balancing loss."""
     inputs = batch[:, :-1]
     targets = batch[:, 1:]
-    logits, _ = forward(params, inputs, config)
+    if config.is_moe:
+        logits, _, aux = forward(params, inputs, config, output_router_losses=True)
+    else:
+        logits, _ = forward(params, inputs, config)
     logp = jax.nn.log_softmax(logits, axis=-1)
     nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
     if loss_mask is not None:
-        return jnp.sum(nll * loss_mask) / jnp.maximum(jnp.sum(loss_mask), 1.0)
-    return jnp.mean(nll)
+        loss = jnp.sum(nll * loss_mask) / jnp.maximum(jnp.sum(loss_mask), 1.0)
+    else:
+        loss = jnp.mean(nll)
+    if config.is_moe:
+        loss = loss + config.router_aux_loss_coef * aux["moe_aux_loss"]
+    return loss
 
 
 def make_train_step(config: ModelConfig, optimizer: optax.GradientTransformation):

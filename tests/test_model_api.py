@@ -66,6 +66,21 @@ def test_hidden_states_first_layer_is_embedding():
     assert aux["final_hidden_state"].shape == (1, 2, cfg.hidden_size)
 
 
+def test_final_hidden_state_is_post_norm():
+    """The reference collects the POST-final-norm output
+    (llama3.2_model.py:708-713); tied logits must equal
+    final_hidden_state @ embed.T."""
+    cfg, params = _model()
+    ids = jnp.array([[3, 5, 9]], dtype=jnp.int32)
+    logits, _, aux = forward(params, ids, cfg, output_hidden_states=True)
+    want = np.einsum(
+        "bsh,vh->bsv",
+        np.asarray(aux["final_hidden_state"], np.float32),
+        np.asarray(params["embed_tokens"], np.float32),
+    )
+    np.testing.assert_allclose(np.asarray(logits), want, atol=1e-5)
+
+
 def test_output_attentions_rejects_flash():
     cfg, params = _model()
     ids = jnp.array([[1, 2]], dtype=jnp.int32)

@@ -97,6 +97,35 @@ def test_pp_train_step_runs_and_improves():
     assert float(loss) < float(loss0)
 
 
+def test_pp_moe_loss_includes_router_aux():
+    """PP × EP composition: the pipelined MoE loss includes the router
+    aux loss; with a single microbatch the routing statistics are the
+    full-batch ones, so it matches train.causal_lm_loss exactly."""
+    plan = MeshPlan(pipe=2, expert=2, model=2)
+    cfg = tiny_config(
+        "llama",
+        num_hidden_layers=4,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        head_dim=8,
+        hidden_size=32,
+        intermediate_size=64,
+        num_local_experts=4,
+        num_experts_per_tok=2,
+    )
+    plan.validate(cfg)
+    mesh = make_mesh(plan)
+    params = init_params(jax.random.PRNGKey(7), cfg, dtype=jnp.float32)
+    sharded = shard_params(params, cfg, plan, mesh)
+    batch = jnp.asarray(
+        np.random.default_rng(7).integers(0, cfg.vocab_size, (2, 16)), jnp.int32
+    )
+    ref = causal_lm_loss(params, batch, cfg)
+    loss_fn = make_pp_loss_fn(cfg, plan, mesh, num_microbatches=1)
+    got = loss_fn(sharded, batch)
+    np.testing.assert_allclose(float(got), float(ref), rtol=1e-5)
+
+
 def test_pp_validates_divisibility():
     plan = MeshPlan(pipe=3)
     cfg = tiny_config("llama", num_hidden_layers=4)
