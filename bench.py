@@ -93,6 +93,21 @@ def main() -> None:
             "ttft_s_p50": round(ttft, 4),
         }
 
+    # int8 weight-only quantization (quant.py): halves the per-step HBM
+    # weight stream — reported separately since numerics differ from bf16.
+    from llm_np_cp_tpu.quant import quantize_params
+
+    qparams = quantize_params(params)
+    for batch in (1, 8):
+        ttft, rate = _measure(
+            config, qparams, prefill, loop, batch, prompt_len, decode_tokens
+        )
+        detail[f"int8_bs{batch}"] = {
+            "decode_tok_s_chip": round(rate, 1),
+            "per_seq_tok_s": round(rate / batch, 1),
+            "ttft_s_p50": round(ttft, 4),
+        }
+
     rate = detail["bs8"]["decode_tok_s_chip"]
     result = {
         "metric": "decode_tokens_per_sec_per_chip",

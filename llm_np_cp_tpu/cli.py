@@ -38,6 +38,9 @@ def build_parser(default_model: str) -> argparse.ArgumentParser:
     p.add_argument("--temperature", type=float, default=1.0)
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--dtype", choices=["bf16", "f32"], default="bf16")
+    p.add_argument("--quantize", choices=["none", "int8"], default="none",
+                   help="int8 = weight-only quantization (halves decode HBM "
+                        "traffic; single-chip only)")
     p.add_argument("--mesh", default="1,1,1",
                    help="data,seq,model parallel degrees (e.g. 1,1,8 for TP=8)")
     p.add_argument("--max-seq-len", type=int, default=None,
@@ -56,6 +59,9 @@ def build_parser(default_model: str) -> argparse.ArgumentParser:
 def run(argv: list[str] | None = None, default_model: str = "meta-llama/Llama-3.2-1B") -> str:
     args = build_parser(default_model).parse_args(argv)
     if args.backend == "numpy":
+        if args.quantize != "none":
+            raise SystemExit("--quantize applies to the tpu backend only "
+                             "(the numpy oracle is fp32 by definition)")
         return _run_numpy(args)
     return _run_tpu(args)
 
@@ -156,9 +162,16 @@ def _run_tpu(args) -> str:
     plan = MeshPlan(data=data, seq=seq, model=model)
     mesh = None
     if plan.num_devices > 1:
+        if args.quantize != "none":
+            raise SystemExit("--quantize is single-chip only (no sharded specs "
+                             "for quantized params yet)")
         plan.validate(config)
         mesh = make_mesh(plan)
         params = shard_params(params, config, plan, mesh)
+    if args.quantize == "int8":
+        from llm_np_cp_tpu.quant import quantize_params
+
+        params = quantize_params(params)
 
     sampler = Sampler(
         kind=args.sampler, temperature=args.temperature, p_base=args.p_base

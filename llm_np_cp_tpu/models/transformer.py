@@ -39,6 +39,7 @@ from llm_np_cp_tpu.ops.activations import ACT2FN, softcap
 from llm_np_cp_tpu.ops.attention import causal_mask, gqa_attention
 from llm_np_cp_tpu.ops.norms import rms_norm
 from llm_np_cp_tpu.ops.rope import apply_rope, rope_cos_sin
+from llm_np_cp_tpu.quant import quant_einsum
 
 Params = dict[str, Any]
 
@@ -119,20 +120,28 @@ def init_params(
 # Forward
 # ----------------------------------------------------------------------
 
-def _project(x: jnp.ndarray, w: jnp.ndarray) -> jnp.ndarray:
-    return jnp.einsum("bsh,ho->bso", x, w, preferred_element_type=jnp.float32).astype(
-        x.dtype
-    )
+def compute_dtype(params: Params) -> jnp.dtype:
+    """Activation dtype: the norm gammas' dtype (always a float leaf, even
+    when the matmul weights are int8-quantized — quant.py)."""
+    return params["final_norm"].dtype
+
+
+def _project(x: jnp.ndarray, w: Any) -> jnp.ndarray:
+    return quant_einsum("bsh,ho->bso", x, w).astype(x.dtype)
 
 
 def embed_inputs(params: Params, input_ids: jnp.ndarray, config: ModelConfig) -> jnp.ndarray:
     """Token embedding lookup (+ Gemma's sqrt(hidden) scaling,
     gemma2_model.py:738-739, applied in the weight dtype to match the
     reference's bf16 rounding)."""
-    compute_dtype = params["embed_tokens"].dtype
-    x = params["embed_tokens"][input_ids].astype(compute_dtype)
+    dtype = compute_dtype(params)
+    emb = params["embed_tokens"]
+    if isinstance(emb, dict):  # int8 rows with per-row scales
+        x = (emb["q"][input_ids].astype(jnp.float32) * emb["s"][input_ids]).astype(dtype)
+    else:
+        x = emb[input_ids].astype(dtype)
     if config.scale_embeddings:
-        normalizer = jnp.array(math.sqrt(config.hidden_size), dtype=compute_dtype)
+        normalizer = jnp.array(math.sqrt(config.hidden_size), dtype=dtype)
         x = x * normalizer
     return x
 
@@ -148,15 +157,9 @@ def final_logits(
     if last_only:
         x = x[:, -1:, :]
     if config.tie_word_embeddings:
-        logits = jnp.einsum(
-            "bsh,vh->bsv", x, params["embed_tokens"],
-            preferred_element_type=jnp.float32,
-        )
+        logits = quant_einsum("bsh,vh->bsv", x, params["embed_tokens"])
     else:
-        logits = jnp.einsum(
-            "bsh,hv->bsv", x, params["lm_head"],
-            preferred_element_type=jnp.float32,
-        )
+        logits = quant_einsum("bsh,hv->bsv", x, params["lm_head"])
     if config.final_logit_softcapping is not None:
         logits = softcap(logits, config.final_logit_softcapping)
     return logits.astype(jnp.float32)
@@ -342,7 +345,7 @@ def forward(
             "(ragged batches); use attn_impl='xla'"
         )
     b, s = input_ids.shape
-    compute_dtype = params["embed_tokens"].dtype
+    act_dtype = compute_dtype(params)
 
     offset = cache.length if cache is not None else jnp.zeros((), jnp.int32)
     if positions is None:
@@ -400,8 +403,8 @@ def forward(
         k_cache, v_cache = cache.k, cache.v
     else:
         # Scan still needs per-layer xs of uniform shape; use zero-size dummies.
-        k_cache = jnp.zeros((num_layers, 0), dtype=compute_dtype)
-        v_cache = jnp.zeros((num_layers, 0), dtype=compute_dtype)
+        k_cache = jnp.zeros((num_layers, 0), dtype=act_dtype)
+        v_cache = jnp.zeros((num_layers, 0), dtype=act_dtype)
 
     def layer_step(x: jnp.ndarray, xs: tuple) -> tuple[jnp.ndarray, tuple]:
         w, k_l, v_l, sliding = xs

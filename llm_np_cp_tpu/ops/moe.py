@@ -28,6 +28,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from llm_np_cp_tpu.quant import quant_einsum
+
 
 def _group_split(t: int, group_size: int) -> int:
     """Largest divisor of t that is ≤ group_size (group length gs; G=t/gs)."""
@@ -91,15 +93,9 @@ def moe_mlp(
     expert_in = jnp.einsum(
         "gtec,gth->gech", dispatch, xg, preferred_element_type=jnp.float32
     ).astype(x.dtype)
-    gate_h = act(
-        jnp.einsum("gech,ehi->geci", expert_in, gate_w, preferred_element_type=jnp.float32)
-    ).astype(x.dtype)
-    up_h = jnp.einsum(
-        "gech,ehi->geci", expert_in, up_w, preferred_element_type=jnp.float32
-    ).astype(x.dtype)
-    expert_out = jnp.einsum(
-        "geci,eih->gech", gate_h * up_h, down_w, preferred_element_type=jnp.float32
-    ).astype(x.dtype)
+    gate_h = act(quant_einsum("gech,ehi->geci", expert_in, gate_w)).astype(x.dtype)
+    up_h = quant_einsum("gech,ehi->geci", expert_in, up_w).astype(x.dtype)
+    expert_out = quant_einsum("geci,eih->gech", gate_h * up_h, down_w).astype(x.dtype)
 
     combine = dispatch * gates.reshape(g, gs, e).astype(x.dtype)[..., None]
     out = jnp.einsum(

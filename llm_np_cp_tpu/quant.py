@@ -1,0 +1,126 @@
+"""Weight-only int8 quantization for decode.
+
+Single-chip decode is HBM-bandwidth-bound: every step streams the full
+weight set (bf16 Llama-3.2-1B = 2.47 GB ÷ ~819 GB/s ≈ 331 steps/s
+ceiling — measured ~80% of that).  The reference has no quantization at
+all; on TPU the natural lever is storing weights as int8 with
+per-output-channel float scales and dequantizing *inside* the fused
+matmul read: XLA folds the ``int8 → bf16`` convert and the scale multiply
+into the GEMM's operand pipeline, so HBM traffic halves while the MXU
+still runs bf16×bf16.
+
+Representation: a quantized matrix is the dict ``{"q": int8, "s": f32}``
+(same pytree position as the original array), with ``s`` broadcast along
+the *input* axis:
+
+- projections ``[in, out]`` → per-out-channel scale ``[out]``
+- stacked layers ``[L, in, out]`` → ``[L, 1, out]``
+- embedding ``[V, H]`` → per-row scale ``[V, 1]`` (the row is the output
+  channel of the tied lm_head and the gather unit of the embed lookup)
+
+Norm gammas, MoE routers, and anything 1-D stay in the float dtype —
+they are noise in the byte budget and precision-critical.
+
+Symmetric quantization: ``q = round(w / s)``, ``s = max|w| / 127`` per
+channel.  No activation quantization (activations never touch HBM
+between fused ops).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+Params = dict[str, Any]
+
+# weights quantized along their contraction-input axis (per-output scales)
+_QUANT_KEYS = {
+    "q_proj", "k_proj", "v_proj", "o_proj",
+    "gate_proj", "up_proj", "down_proj", "lm_head",
+}
+
+
+def is_quantized(w: Any) -> bool:
+    return isinstance(w, dict) and "q" in w and "s" in w
+
+
+def quantize_array(w: jnp.ndarray, *, axis: int) -> dict[str, jnp.ndarray]:
+    """Symmetric int8 quantization of ``w`` along ``axis`` (the contraction
+    axis): scales have size 1 there and the full size elsewhere is kept
+    broadcastable."""
+    w32 = w.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(w32), axis=axis, keepdims=True)
+    s = jnp.where(amax > 0, amax / 127.0, 1.0)
+    q = jnp.clip(jnp.round(w32 / s), -127, 127).astype(jnp.int8)
+    return {"q": q, "s": s.astype(jnp.float32)}
+
+
+def dequantize(w: Any, dtype: jnp.dtype = jnp.float32) -> jnp.ndarray:
+    if not is_quantized(w):
+        return w
+    return (w["q"].astype(jnp.float32) * w["s"]).astype(dtype)
+
+
+def quantize_params(params: Params, *, embed: bool = True) -> Params:
+    """Quantize every projection matrix (and optionally the embedding /
+    tied lm_head table) of a transformer param pytree in place-shape.
+
+    The result drops into ``models.transformer.forward`` unchanged —
+    ``_project`` / ``embed_inputs`` / ``final_logits`` detect the dict
+    leaves.  Sharded quantized params are not supported yet (the specs
+    pytree would need the same dict structure); quantization targets the
+    single-chip decode path.
+    """
+    out = dict(params)
+    layers = dict(params["layers"])
+    for key in list(layers.keys()):
+        if key in _QUANT_KEYS:
+            # stacked [L, in, out] (dense) or [L, E, in, out] (MoE experts):
+            # contraction axis is always -2
+            layers[key] = quantize_array(layers[key], axis=-2)
+    out["layers"] = layers
+    if embed:
+        # [V, H]: per-row scales serve both the embed gather and the tied
+        # lm_head (row = vocab output channel)
+        out["embed_tokens"] = quantize_array(params["embed_tokens"], axis=-1)
+    if "lm_head" in params:
+        out["lm_head"] = quantize_array(params["lm_head"], axis=-2)
+    return out
+
+
+def _align_scale(spec: str, s: jnp.ndarray) -> jnp.ndarray:
+    """Reshape a keepdims scale tensor (same rank as the einsum's second
+    operand, size 1 on contracted axes) so it broadcasts against the
+    einsum's OUTPUT — the single place that knows the scale layout."""
+    ins, out = spec.replace(" ", "").split("->")
+    _, w_idx = ins.split(",")
+    drop = tuple(i for i, c in enumerate(w_idx) if c not in out)
+    s2 = jnp.squeeze(s, axis=drop)
+    kept = [c for c in w_idx if c in out]
+    s2 = jnp.transpose(s2, sorted(range(len(kept)), key=lambda i: out.index(kept[i])))
+    kept_sorted = sorted(kept, key=out.index)
+    return s2.reshape([
+        s2.shape[kept_sorted.index(c)] if c in kept_sorted else 1 for c in out
+    ])
+
+
+def quant_einsum(spec: str, x: jnp.ndarray, w: Any) -> jnp.ndarray:
+    """``einsum(spec, x, w)`` in f32 accumulation, accepting either a plain
+    array or a quantized ``{"q", "s"}`` dict for ``w`` (matmul the int8
+    payload in x.dtype, then rescale the output).  All weight-consuming
+    einsums in the model go through this."""
+    if not is_quantized(w):
+        return jnp.einsum(spec, x, w, preferred_element_type=jnp.float32)
+    y = jnp.einsum(
+        spec, x, w["q"].astype(x.dtype), preferred_element_type=jnp.float32
+    )
+    return y * _align_scale(spec, w["s"])
+
+
+def param_bytes(params: Params) -> int:
+    """Total HBM bytes of a (possibly quantized) param pytree."""
+    return sum(
+        leaf.size * leaf.dtype.itemsize for leaf in jax.tree.leaves(params)
+    )

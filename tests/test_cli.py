@@ -104,3 +104,19 @@ def test_cli_stream_metrics_counts_generated(fake_load, capsys):
     err = capsys.readouterr().err
     assert "streamed" in err and "ttft" in err
     assert "streamed 4 tokens" in err or "streamed 3" in err
+
+
+def test_cli_quantize_int8(fake_load, capsys):
+    text = cli.run(["--backend=tpu", "--quantize=int8", "--sampler=greedy",
+                    "--max-tokens=5", "--dtype=f32", "--no-stream",
+                    "--prompt=hello"])
+    assert text
+    ref = cli.run(["--backend=tpu", "--sampler=greedy", "--max-tokens=5",
+                   "--dtype=f32", "--no-stream", "--prompt=hello"])
+    # int8 tracks fp closely at toy scale; greedy decode usually agrees
+    assert len(text) == len(ref)
+
+
+def test_cli_quantize_rejects_mesh(fake_load):
+    with pytest.raises(SystemExit, match="single-chip"):
+        cli.run(["--backend=tpu", "--quantize=int8", "--mesh=1,1,2"])
