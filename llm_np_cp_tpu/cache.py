@@ -67,6 +67,24 @@ class KVCache(NamedTuple):
         return jnp.arange(self.max_seq_len, dtype=jnp.int32)
 
 
+def truncate(cache: KVCache, new_length: jnp.ndarray) -> KVCache:
+    """Logically roll the cache back to ``new_length`` tokens.
+
+    The K/V slabs are left in place — slots ≥ new_length are marked invalid
+    in the bitmap and ``length`` moves back, so subsequent writes overwrite
+    them and attention (which masks on slot validity + position) never
+    reads them.  O(1); the rollback primitive speculative decoding needs
+    to discard rejected draft tokens.
+    """
+    keep = jnp.arange(cache.max_seq_len, dtype=jnp.int32)[None, :] < new_length
+    return KVCache(
+        k=cache.k,
+        v=cache.v,
+        valid=cache.valid & keep,
+        length=new_length.astype(jnp.int32),
+    )
+
+
 def update_layer(
     k_layer: jnp.ndarray,
     v_layer: jnp.ndarray,

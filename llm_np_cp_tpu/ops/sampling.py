@@ -95,18 +95,39 @@ class Sampler:
         logits = logits.astype(jnp.float32)
         if self.kind == "greedy":
             return greedy(logits)
+        if self.kind == "cdf":
+            if self.temperature != 1.0:
+                logits = logits / self.temperature
+            return sample_cdf(key, logits)
+        # min_p / top_k / top_p: sampling from the masked logits IS the
+        # filtered distribution — one dispatch chain, shared with
+        # speculative decoding via filtered_logits
+        return jax.random.categorical(
+            key, self.filtered_logits(logits), axis=-1
+        ).astype(jnp.int32)
+
+    def filtered_logits(self, logits: jnp.ndarray) -> jnp.ndarray:
+        """Post-filter logits whose softmax is this sampler's effective
+        token distribution (``categorical(filtered_logits)`` ≡ __call__ in
+        distribution).  Greedy degenerates to a one-hot on ``argmax`` —
+        the FIRST maximal index, matching ``greedy()``'s tie-breaking so
+        speculative greedy stays byte-identical even when logits tie
+        (softcap saturation and int8 weights do produce exact ties).
+        Speculative decoding consumes these for both draft and target.
+        """
+        logits = logits.astype(jnp.float32)
+        if self.kind == "greedy":
+            idx = jnp.argmax(logits, axis=-1, keepdims=True)
+            iota = jnp.arange(logits.shape[-1])
+            return jnp.where(iota == idx, 0.0, NEG_INF)
         if self.temperature != 1.0:
             logits = logits / self.temperature
         if self.kind == "min_p":
-            return min_p(key, logits, self.p_base)
+            return min_p_mask(logits, self.p_base)
         if self.kind == "cdf":
-            return sample_cdf(key, logits)
+            return logits
         if self.kind == "top_k":
-            return jax.random.categorical(
-                key, top_k_mask(logits, self.top_k), axis=-1
-            ).astype(jnp.int32)
+            return top_k_mask(logits, self.top_k)
         if self.kind == "top_p":
-            return jax.random.categorical(
-                key, top_p_mask(logits, self.top_p), axis=-1
-            ).astype(jnp.int32)
+            return top_p_mask(logits, self.top_p)
         raise ValueError(f"unknown sampler kind: {self.kind}")

@@ -1,0 +1,331 @@
+"""Speculative decoding: draft-and-verify autoregressive generation.
+
+Framework extension (the reference decodes strictly one token per forward,
+llama3.2_model.py:865-902).  A cheap *draft* model proposes γ tokens
+autoregressively; the *target* model scores all of them in ONE forward
+(prefill-shaped, MXU-friendly); accepted prefixes keep the target's exact
+output distribution via the Leviathan et al. accept/resample rule:
+
+    accept dᵢ with prob min(1, p(dᵢ)/q(dᵢ));
+    on first rejection resample from norm(max(p − q, 0));
+    if all γ accepted, sample a bonus token from p — so every round emits
+    between 1 and γ+1 tokens and the sampled distribution is *identical*
+    to decoding with the target alone (greedy: byte-identical output).
+
+TPU-native shape: one jitted ``spec_round`` per (γ, sampler) — the draft
+loop is a ``lax.scan``, verification is a single γ+1-token forward, and
+rejected tokens are rolled back with ``cache.truncate`` (an O(1) bitmap
+mask — the preallocated cache never moves).  p and q are the *filtered*
+sampler distributions (``Sampler.filtered_logits``), so min-p/top-k/top-p
+speculation is exact too, not just plain-softmax sampling.
+
+The default draft is the int8-quantized target (quant.py) — "self
+speculation": no second checkpoint, ~2× cheaper per draft step, and
+high acceptance because the quantized model rarely disagrees with bf16.
+A genuinely smaller draft model can be passed explicitly.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from llm_np_cp_tpu.cache import KVCache, truncate
+from llm_np_cp_tpu.config import ModelConfig
+from llm_np_cp_tpu.generate import _check_capacity, make_prefill_fn
+from llm_np_cp_tpu.models.transformer import forward
+from llm_np_cp_tpu.ops.sampling import Sampler
+
+Params = dict[str, Any]
+
+
+@dataclasses.dataclass
+class SpecResult:
+    tokens: np.ndarray  # [num_generated]
+    ttft_s: float
+    decode_tokens_per_s: float
+    num_generated: int
+    rounds: int
+    acceptance_rate: float  # accepted draft tokens / proposed draft tokens
+    tokens_per_round: float
+
+
+def _spec_round_core(
+    draft_params: Params,
+    target_params: Params,
+    t0: jnp.ndarray,
+    dcache: KVCache,
+    tcache: KVCache,
+    key: jax.Array,
+    *,
+    draft_config: ModelConfig,
+    target_config: ModelConfig,
+    gamma: int,
+    sampler: Sampler,
+    draft_sampler: Sampler,
+):
+    """Traced body of one speculative round (batch 1) — see module doc."""
+    kd, ku, kc = jax.random.split(key, 3)
+    t_base = tcache.length
+    d_base = dcache.length
+
+    # --- draft: γ+1 steps (the extra step's proposal is discarded but
+    # leaves the draft cache covering every verified input, so the
+    # post-round rollback target base+n+1 always exists)
+    def dstep(carry, k):
+        tok, dc = carry
+        logits, dc = forward(
+            draft_params, tok[:, None], draft_config, dc, logits_last_only=True
+        )
+        fl = draft_sampler.filtered_logits(logits[:, -1])  # [1, V]
+        nxt = jax.random.categorical(k, fl, axis=-1).astype(jnp.int32)
+        return (nxt, dc), (nxt[0], jax.nn.softmax(fl[0], axis=-1))
+
+    dkeys = jax.random.split(kd, gamma + 1)
+    (_, dcache2), (drafts, qprobs) = lax.scan(dstep, (t0, dcache), dkeys)
+    d = drafts[:gamma]  # proposals d_1..d_γ
+
+    # --- target: verify all proposals in one forward
+    inp = jnp.concatenate([t0, d])[None, :]  # [1, γ+1]
+    tlogits, tcache2 = forward(target_params, inp, target_config, tcache)
+    p = jax.nn.softmax(sampler.filtered_logits(tlogits[0]), axis=-1)  # [γ+1, V]
+
+    # --- accept/reject (multiplied form avoids div-by-zero; q(d) > 0
+    # by construction since d was sampled from q)
+    idx = jnp.arange(gamma)
+    p_d = p[idx, d]
+    q_d = qprobs[idx, d]
+    u = jax.random.uniform(ku, (gamma,), dtype=jnp.float32)
+    accept = u * q_d < p_d
+    n = jnp.where(jnp.all(accept), gamma, jnp.argmin(accept))
+
+    # --- correction (n < γ: residual norm(max(p−q, 0))) or bonus
+    # (n == γ: plain p) — unified by a zero row AT position γ (qprobs has
+    # γ+1 rows; its last row is the discarded extra draft step's
+    # distribution and must NOT leak into the bonus sample)
+    q_pad = jnp.concatenate(
+        [qprobs[:gamma], jnp.zeros((1,) + qprobs.shape[1:])]
+    )
+    residual = jnp.maximum(p[n] - q_pad[n], 0.0)
+    total = jnp.sum(residual)
+    dist = jnp.where(total > 0, residual / jnp.maximum(total, 1e-38), p[n])
+    c = jax.random.categorical(kc, jnp.log(dist + 1e-38), axis=-1).astype(jnp.int32)
+
+    emitted = jnp.concatenate([d, jnp.zeros((1,), jnp.int32)]).at[n].set(c)
+    count = n + 1
+
+    # --- roll both caches back to the accepted inputs t0..d_n
+    tcache2 = truncate(tcache2, t_base + count)
+    dcache2 = truncate(dcache2, d_base + count)
+    return emitted, count, dcache2, tcache2, c[None]
+
+
+def make_spec_round_fn(
+    draft_config: ModelConfig,
+    target_config: ModelConfig,
+    gamma: int,
+    sampler: Sampler,
+    draft_sampler: Sampler | None = None,
+):
+    """One jitted speculative round (granular API; one dispatch per round).
+
+    (draft_params, target_params, t0 [1], dcache, tcache, key) →
+    (emitted [γ+1] (only the first ``count`` are real), count, dcache,
+    tcache, next_t0 [1]).
+    """
+    from functools import partial
+
+    return jax.jit(
+        partial(
+            _spec_round_core,
+            draft_config=draft_config,
+            target_config=target_config,
+            gamma=gamma,
+            sampler=sampler,
+            draft_sampler=draft_sampler or sampler,
+        )
+    )
+
+
+def make_spec_decode_fn(
+    draft_config: ModelConfig,
+    target_config: ModelConfig,
+    gamma: int,
+    sampler: Sampler,
+    draft_sampler: Sampler | None = None,
+    stop_tokens: tuple[int, ...] = (),
+):
+    """The fused loop: ALL speculative rounds in one ``lax.while_loop`` —
+    a single device dispatch for the whole generation (per-round host
+    sync costs a full transport RTT on a tunneled chip, same reason
+    generate.py fuses its decode scan).
+
+    (draft_params, target_params, t0 [1], dcache, tcache, key, max_new) →
+    (buf [max_new+γ+1] (first ``total`` real, t0 included), total,
+    rounds, accepted, dcache, tcache).
+    """
+    from functools import partial
+
+    draft_sampler_ = draft_sampler or sampler
+    stops = jnp.asarray(stop_tokens, dtype=jnp.int32) if stop_tokens else None
+
+    @partial(jax.jit, static_argnums=(6,))
+    def spec_decode(
+        draft_params: Params,
+        target_params: Params,
+        t0: jnp.ndarray,
+        dcache: KVCache,
+        tcache: KVCache,
+        key: jax.Array,
+        max_new: int,
+    ):
+        buf = jnp.zeros((max_new + gamma + 1,), jnp.int32).at[0].set(t0[0])
+        done0 = (
+            jnp.any(t0[0] == stops) if stops is not None else jnp.array(False)
+        )
+        state = (
+            jnp.ones((), jnp.int32),  # total emitted (t0 included)
+            done0,
+            t0,
+            dcache,
+            tcache,
+            key,
+            buf,
+            jnp.zeros((), jnp.int32),  # rounds
+            jnp.zeros((), jnp.int32),  # accepted draft tokens
+        )
+
+        def cond(state):
+            total, done = state[0], state[1]
+            return (total < max_new) & ~done
+
+        def body(state):
+            total, done, t, dcache, tcache, key, buf, rounds, accepted = state
+            key, kr = jax.random.split(key)
+            emitted, count, dcache, tcache, t = _spec_round_core(
+                draft_params, target_params, t, dcache, tcache, kr,
+                draft_config=draft_config, target_config=target_config,
+                gamma=gamma, sampler=sampler, draft_sampler=draft_sampler_,
+            )
+            # write the whole γ+1 window; slots past `count` are garbage the
+            # next round overwrites (buf is oversized by γ+1 for the tail)
+            buf = lax.dynamic_update_slice(buf, emitted, (total,))
+            if stops is not None:
+                real = jnp.arange(gamma + 1) < count
+                done = done | jnp.any(
+                    real[:, None] & (emitted[:, None] == stops[None, :])
+                )
+            return (
+                total + count, done, t, dcache, tcache, key, buf,
+                rounds + 1, accepted + count - 1,
+            )
+
+        total, _, _, dcache, tcache, _, buf, rounds, accepted = lax.while_loop(
+            cond, body, state
+        )
+        return buf, total, rounds, accepted, dcache, tcache
+
+    return spec_decode
+
+
+class SpeculativeGenerator:
+    """Owns the jitted prefill + spec-round programs (batch size 1).
+
+    draft defaults to the int8-quantized target params (self-speculation);
+    pass ``draft_params``/``draft_config`` for a separate small model
+    (they must share the tokenizer/vocab).
+    """
+
+    def __init__(
+        self,
+        params: Params,
+        config: ModelConfig,
+        *,
+        draft_params: Params | None = None,
+        draft_config: ModelConfig | None = None,
+        gamma: int = 4,
+        sampler: Sampler | None = None,
+        draft_sampler: Sampler | None = None,
+        cache_dtype: jnp.dtype = jnp.bfloat16,
+    ) -> None:
+        if draft_params is None:
+            from llm_np_cp_tpu.quant import quantize_params
+
+            draft_params = quantize_params(params)
+        self.params = params
+        self.config = config
+        self.draft_params = draft_params
+        self.draft_config = draft_config or config
+        self.gamma = gamma
+        self.sampler = sampler or Sampler()
+        self._prefill_t = make_prefill_fn(config, self.sampler)
+        self._prefill_d = make_prefill_fn(self.draft_config, self.sampler)
+        self._draft_sampler = draft_sampler
+        self._loops: dict[tuple, Any] = {}  # fused loop per stop-token set
+        self.cache_dtype = cache_dtype
+
+    def _loop(self, stop_tokens: tuple[int, ...]):
+        if stop_tokens not in self._loops:
+            self._loops[stop_tokens] = make_spec_decode_fn(
+                self.draft_config, self.config, self.gamma, self.sampler,
+                self._draft_sampler, stop_tokens,
+            )
+        return self._loops[stop_tokens]
+
+    def generate(
+        self,
+        prompt_ids: np.ndarray,
+        max_new_tokens: int,
+        *,
+        max_seq_len: int | None = None,
+        seed: int = 0,
+        stop_tokens: tuple[int, ...] = (),
+    ) -> SpecResult:
+        prompt_ids = jnp.asarray(prompt_ids, dtype=jnp.int32).reshape(1, -1)
+        s = prompt_ids.shape[1]
+        # rounds overshoot by up to γ+1 tokens before rollback trims them
+        max_seq_len = max_seq_len or s + max_new_tokens + self.gamma + 1
+        _check_capacity(s, max_new_tokens + self.gamma + 1, max_seq_len)
+
+        key = jax.random.PRNGKey(seed)
+        key, kp = jax.random.split(key)
+        tcache = KVCache.init(self.config, 1, max_seq_len, dtype=self.cache_dtype)
+        dcache = KVCache.init(self.draft_config, 1, max_seq_len, dtype=self.cache_dtype)
+
+        t0_wall = time.perf_counter()
+        tok, tcache, _ = self._prefill_t(self.params, prompt_ids, tcache, kp)
+        _, dcache, _ = self._prefill_d(self.draft_params, prompt_ids, dcache, kp)
+        int(tok[0])  # force
+        ttft = time.perf_counter() - t0_wall
+
+        # the whole speculative loop is ONE dispatch (lax.while_loop)
+        t_dec = time.perf_counter()
+        buf, total, rounds, accepted, dcache, tcache = self._loop(stop_tokens)(
+            self.draft_params, self.params, tok, dcache, tcache, key,
+            max_new_tokens,
+        )
+        buf = np.asarray(buf)  # forces completion (D2H)
+        decode_s = time.perf_counter() - t_dec
+        total, rounds, accepted = int(total), int(rounds), int(accepted)
+
+        tokens = buf[: min(total, max_new_tokens)].astype(np.int32)
+        if stop_tokens:
+            hits = np.isin(tokens, stop_tokens).nonzero()[0]
+            if hits.size:
+                tokens = tokens[: hits[0] + 1]
+        n_dec = total - 1  # tokens produced after the prefill token
+        return SpecResult(
+            tokens=tokens,
+            ttft_s=ttft,
+            decode_tokens_per_s=n_dec / decode_s if decode_s > 0 else float("nan"),
+            num_generated=len(tokens),
+            rounds=rounds,
+            acceptance_rate=accepted / (rounds * self.gamma) if rounds else 0.0,
+            tokens_per_round=n_dec / rounds if rounds else 0.0,
+        )

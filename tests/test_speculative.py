@@ -1,0 +1,165 @@
+"""Speculative decoding invariants.
+
+The load-bearing property: speculative decoding is LOSSLESS — greedy
+output is byte-identical to target-only greedy decoding *regardless of
+the draft* (even a random unrelated draft), because every emitted token
+is either verified against or resampled from the target distribution.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from llm_np_cp_tpu.config import tiny_config
+from llm_np_cp_tpu.generate import Generator
+from llm_np_cp_tpu.models.transformer import init_params
+from llm_np_cp_tpu.ops.sampling import Sampler
+from llm_np_cp_tpu.speculative import SpeculativeGenerator
+
+CFG = tiny_config("llama")
+
+
+def _params(seed):
+    return init_params(jax.random.PRNGKey(seed), CFG, dtype=jnp.float32)
+
+
+def _prompt(seed, n=8):
+    return np.random.default_rng(seed).integers(0, CFG.vocab_size, n).astype(np.int32)
+
+
+@pytest.mark.parametrize("gamma", [1, 3, 4])
+def test_greedy_spec_equals_plain_greedy_any_draft(gamma):
+    """Greedy speculation with a COMPLETELY UNRELATED random draft must
+    still reproduce the target's greedy decode exactly."""
+    target = _params(0)
+    wrong_draft = _params(99)
+    prompt = _prompt(0)
+    n = 24
+
+    plain = Generator(target, CFG, sampler=Sampler(kind="greedy"),
+                      cache_dtype=jnp.float32)
+    want = plain.generate(prompt, n).tokens[0]
+
+    spec = SpeculativeGenerator(
+        target, CFG, draft_params=wrong_draft, gamma=gamma,
+        sampler=Sampler(kind="greedy"), cache_dtype=jnp.float32,
+    )
+    got = spec.generate(prompt, n).tokens
+    np.testing.assert_array_equal(got, np.asarray(want))
+
+
+def test_greedy_spec_with_perfect_draft_accepts_everything():
+    target = _params(0)
+    prompt = _prompt(1)
+    spec = SpeculativeGenerator(
+        target, CFG, draft_params=target, gamma=4,
+        sampler=Sampler(kind="greedy"), cache_dtype=jnp.float32,
+    )
+    res = spec.generate(prompt, 21)
+    assert res.acceptance_rate == 1.0
+    # every round emits γ+1 tokens: 20 decode tokens in 4 rounds
+    assert res.rounds == 4
+    assert res.tokens_per_round == 5.0
+
+
+def test_greedy_spec_quantized_self_draft():
+    """Default draft (int8 self-quantization) is still lossless."""
+    target = _params(2)
+    prompt = _prompt(2)
+    plain = Generator(target, CFG, sampler=Sampler(kind="greedy"),
+                      cache_dtype=jnp.float32)
+    want = plain.generate(prompt, 16).tokens[0]
+    spec = SpeculativeGenerator(
+        target, CFG, gamma=3, sampler=Sampler(kind="greedy"),
+        cache_dtype=jnp.float32,
+    )
+    res = spec.generate(prompt, 16)
+    np.testing.assert_array_equal(res.tokens, np.asarray(want))
+    # int8 self-draft agrees with fp target nearly always at toy scale
+    assert res.acceptance_rate > 0.5
+
+
+def test_sampled_spec_with_perfect_draft_accepts_everything():
+    """With draft == target, p == q so min(1, p/q) == 1: acceptance must
+    be exact regardless of sampler kind."""
+    target = _params(3)
+    prompt = _prompt(3)
+    for kind in ("min_p", "top_k", "cdf"):
+        spec = SpeculativeGenerator(
+            target, CFG, draft_params=target, gamma=4,
+            sampler=Sampler(kind=kind), cache_dtype=jnp.float32,
+        )
+        res = spec.generate(prompt, 11, seed=7)
+        assert res.acceptance_rate == 1.0, kind
+        assert np.all(res.tokens >= 0) and np.all(res.tokens < CFG.vocab_size)
+
+
+def test_sampled_spec_valid_with_different_draft():
+    target = _params(4)
+    draft = _params(5)
+    spec = SpeculativeGenerator(
+        target, CFG, draft_params=draft, gamma=4,
+        sampler=Sampler(kind="min_p"), cache_dtype=jnp.float32,
+    )
+    res = spec.generate(_prompt(4), 20, seed=1)
+    assert res.num_generated == 20
+    assert 0.0 <= res.acceptance_rate <= 1.0
+    assert np.all(res.tokens >= 0) and np.all(res.tokens < CFG.vocab_size)
+
+
+def test_sampled_spec_preserves_target_distribution():
+    """Statistical losslessness with an IMPERFECT draft: the marginal
+    distribution of the 3rd generated token (which lands on the bonus
+    position of an all-accepted γ=1 round, or a later round otherwise)
+    must match plain target-only sampling.  Catches bonus/residual
+    distribution bugs (e.g. padding q with the wrong row)."""
+    cfg = tiny_config(
+        "llama", vocab_size=16, hidden_size=16, intermediate_size=32,
+        num_hidden_layers=1, num_attention_heads=2, num_key_value_heads=1,
+        head_dim=8,
+    )
+    target = init_params(jax.random.PRNGKey(0), cfg, dtype=jnp.float32)
+    draft = init_params(jax.random.PRNGKey(1), cfg, dtype=jnp.float32)
+    prompt = np.asarray([3, 7, 1], dtype=np.int32)
+    n_runs = 400
+    sampler = Sampler(kind="cdf", temperature=1.5)
+
+    plain = Generator(target, cfg, sampler=sampler, cache_dtype=jnp.float32)
+    spec = SpeculativeGenerator(
+        target, cfg, draft_params=draft, gamma=1, sampler=sampler,
+        cache_dtype=jnp.float32,
+    )
+    counts_plain = np.zeros(cfg.vocab_size)
+    counts_spec = np.zeros(cfg.vocab_size)
+    for seed in range(n_runs):
+        counts_plain[int(plain.generate(prompt, 3, seed=seed).tokens[0][2])] += 1
+        counts_spec[int(spec.generate(prompt, 3, seed=seed + 10_000).tokens[2])] += 1
+    tv = 0.5 * np.abs(counts_plain / n_runs - counts_spec / n_runs).sum()
+    assert tv < 0.12, f"total-variation distance {tv:.3f} too large"
+
+
+def test_greedy_filtered_logits_matches_argmax_tiebreak():
+    """Exact ties must resolve to the first maximal index in BOTH greedy()
+    and the one-hot filtered distribution."""
+    logits = jnp.asarray([[1.0, 3.0, 3.0, 0.0]])
+    s = Sampler(kind="greedy")
+    fl = s.filtered_logits(logits)
+    assert int(jnp.argmax(fl[0])) == 1
+    assert float(jax.nn.softmax(fl[0])[1]) == 1.0
+
+
+def test_stop_tokens_trim():
+    target = _params(0)
+    plain = Generator(target, CFG, sampler=Sampler(kind="greedy"),
+                      cache_dtype=jnp.float32)
+    want = plain.generate(_prompt(6), 24).tokens[0]
+    stop = int(want[10])
+    spec = SpeculativeGenerator(
+        target, CFG, gamma=4, sampler=Sampler(kind="greedy"),
+        cache_dtype=jnp.float32,
+    )
+    res = spec.generate(_prompt(6), 24, stop_tokens=(stop,))
+    assert stop in res.tokens
+    first = np.nonzero(res.tokens == stop)[0][0]
+    assert first == len(res.tokens) - 1  # nothing after the stop token
