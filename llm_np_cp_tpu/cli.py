@@ -40,7 +40,7 @@ def build_parser(default_model: str) -> argparse.ArgumentParser:
     p.add_argument("--dtype", choices=["bf16", "f32"], default="bf16")
     p.add_argument("--quantize", choices=["none", "int8"], default="none",
                    help="int8 = weight-only quantization (halves decode HBM "
-                        "traffic; single-chip only)")
+                        "traffic; composes with --mesh sharding)")
     p.add_argument("--mesh", default="1,1,1",
                    help="data,seq,model parallel degrees (e.g. 1,1,8 for TP=8)")
     p.add_argument("--max-seq-len", type=int, default=None,
@@ -51,6 +51,10 @@ def build_parser(default_model: str) -> argparse.ArgumentParser:
                    help="fused decode (fastest) instead of token streaming")
     p.add_argument("--flash-prefill", action="store_true",
                    help="use the Pallas flash-attention kernel for prefill")
+    p.add_argument("--speculative", type=int, default=0, metavar="GAMMA",
+                   help="speculative decoding: GAMMA draft proposals per "
+                        "round from an int8 self-draft (exact target "
+                        "distribution; tpu backend, implies --no-stream)")
     p.add_argument("--metrics", action="store_true",
                    help="print tokens/sec and TTFT after generation")
     return p
@@ -158,25 +162,47 @@ def _run_tpu(args) -> str:
 
     tok, params, config = _load(args)
 
-    data, seq, model = (int(x) for x in args.mesh.split(","))
-    plan = MeshPlan(data=data, seq=seq, model=model)
-    mesh = None
-    if plan.num_devices > 1:
-        if args.quantize != "none":
-            raise SystemExit("--quantize is single-chip only (no sharded specs "
-                             "for quantized params yet)")
-        plan.validate(config)
-        mesh = make_mesh(plan)
-        params = shard_params(params, config, plan, mesh)
     if args.quantize == "int8":
         from llm_np_cp_tpu.quant import quantize_params
 
         params = quantize_params(params)
+    data, seq, model = (int(x) for x in args.mesh.split(","))
+    plan = MeshPlan(data=data, seq=seq, model=model)
+    mesh = None
+    if plan.num_devices > 1:
+        plan.validate(config)
+        mesh = make_mesh(plan)
+        params = shard_params(params, config, plan, mesh)
 
     sampler = Sampler(
         kind=args.sampler, temperature=args.temperature, p_base=args.p_base
     )
     eos = getattr(tok, "eos_token_id", None)
+    cache_dtype = jnp.bfloat16 if args.dtype == "bf16" else jnp.float32
+
+    if args.speculative > 0:
+        from llm_np_cp_tpu.speculative import SpeculativeGenerator
+
+        spec = SpeculativeGenerator(
+            params, config, gamma=args.speculative, sampler=sampler,
+            cache_dtype=cache_dtype,
+        )
+        prompt_ids = tok(args.prompt, return_tensors="np")["input_ids"][0]
+        res = spec.generate(
+            prompt_ids, args.max_tokens, seed=args.seed,
+            stop_tokens=(eos,) if eos is not None else (),
+        )
+        text = tok.decode(res.tokens, skip_special_tokens=True)
+        print(text)
+        if args.metrics:
+            print(
+                f"[tpu] speculative γ={args.speculative}: "
+                f"{res.num_generated} tokens, {res.decode_tokens_per_s:.1f} "
+                f"tok/s, accept {res.acceptance_rate:.2f}, "
+                f"{res.tokens_per_round:.2f} tok/round, ttft {res.ttft_s:.3f}s",
+                file=sys.stderr,
+            )
+        return text
     gen = Generator(
         params, config,
         sampler=sampler,

@@ -180,11 +180,44 @@ def to_shardings(mesh: Mesh, specs: Any) -> Any:
     )
 
 
+def _scale_spec(spec: P, leaf: dict) -> P:
+    """PartitionSpec for a quantized leaf's scale tensor: the weight's spec
+    with contracted (size-1 in the scale, >1 in the payload) axes cleared —
+    a size-1 axis cannot be sharded."""
+    q, s = leaf["q"], leaf["s"]
+    entries = list(spec) + [None] * (q.ndim - len(spec))
+    return P(*[
+        None if (s.shape[i] == 1 and q.shape[i] != 1) else entries[i]
+        for i in range(q.ndim)
+    ])
+
+
 def shard_params(params: Any, config: ModelConfig, plan: MeshPlan, mesh: Mesh) -> Any:
-    """Place an existing param pytree onto the mesh."""
+    """Place an existing param pytree onto the mesh.
+
+    Quantized leaves (quant.py ``{"q", "s"}`` dicts) are handled: the int8
+    payload takes the weight's spec, the scale takes the same spec with
+    contracted axes cleared — so int8 weights compose with TP/DP/PP/EP.
+    """
+    from llm_np_cp_tpu.quant import is_quantized
+
     plan.validate(config)
-    shardings = to_shardings(mesh, param_specs(config, plan))
-    return jax.tree.map(jax.device_put, params, shardings)
+    specs = param_specs(config, plan)
+
+    def place(spec: P, leaf: Any) -> Any:
+        if is_quantized(leaf):
+            return {
+                "q": jax.device_put(leaf["q"], NamedSharding(mesh, spec)),
+                "s": jax.device_put(
+                    leaf["s"], NamedSharding(mesh, _scale_spec(spec, leaf))
+                ),
+            }
+        return jax.device_put(leaf, NamedSharding(mesh, spec))
+
+    return jax.tree.map(
+        place, specs, params,
+        is_leaf=lambda x: isinstance(x, P),
+    )
 
 
 def shard_cache(cache: Any, config: ModelConfig, plan: MeshPlan, mesh: Mesh) -> Any:

@@ -69,9 +69,8 @@ def quantize_params(params: Params, *, embed: bool = True) -> Params:
 
     The result drops into ``models.transformer.forward`` unchanged —
     ``_project`` / ``embed_inputs`` / ``final_logits`` detect the dict
-    leaves.  Sharded quantized params are not supported yet (the specs
-    pytree would need the same dict structure); quantization targets the
-    single-chip decode path.
+    leaves — and into ``parallel.sharding.shard_params``, which shards the
+    int8 payload like the original weight and the scales alongside it.
     """
     out = dict(params)
     layers = dict(params["layers"])

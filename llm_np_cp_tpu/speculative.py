@@ -255,9 +255,14 @@ class SpeculativeGenerator:
         cache_dtype: jnp.dtype = jnp.bfloat16,
     ) -> None:
         if draft_params is None:
-            from llm_np_cp_tpu.quant import quantize_params
+            from llm_np_cp_tpu.quant import is_quantized, quantize_params
 
-            draft_params = quantize_params(params)
+            if is_quantized(params["layers"].get("q_proj")):
+                # target already int8 — nothing cheaper to derive; a
+                # perfect draft (p == q) still pipelines γ+1 tokens/round
+                draft_params = params
+            else:
+                draft_params = quantize_params(params)
         self.params = params
         self.config = config
         self.draft_params = draft_params

@@ -117,6 +117,23 @@ def test_cli_quantize_int8(fake_load, capsys):
     assert len(text) == len(ref)
 
 
-def test_cli_quantize_rejects_mesh(fake_load):
-    with pytest.raises(SystemExit, match="single-chip"):
-        cli.run(["--backend=tpu", "--quantize=int8", "--mesh=1,1,2"])
+def test_cli_quantize_composes_with_mesh(fake_load, capsys):
+    text = cli.run(["--backend=tpu", "--quantize=int8", "--mesh=2,1,2",
+                    "--sampler=greedy", "--max-tokens=5", "--dtype=f32",
+                    "--no-stream", "--prompt=hello"])
+    assert text
+
+
+def test_cli_quantize_rejects_numpy_backend(fake_load):
+    with pytest.raises(SystemExit, match="tpu backend only"):
+        cli.run(["--backend=numpy", "--quantize=int8"])
+
+
+def test_cli_speculative(fake_load, capsys):
+    text = cli.run(["--backend=tpu", "--speculative=2", "--sampler=greedy",
+                    "--max-tokens=8", "--dtype=f32", "--prompt=hello",
+                    "--metrics"])
+    ref = cli.run(["--backend=tpu", "--sampler=greedy", "--max-tokens=8",
+                   "--dtype=f32", "--no-stream", "--prompt=hello"])
+    assert text == ref  # speculative greedy is lossless
+    assert "accept" in capsys.readouterr().err

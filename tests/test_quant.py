@@ -92,6 +92,31 @@ def test_param_bytes_shrink():
     assert param_bytes(qparams) < 0.65 * param_bytes(params)
 
 
+def test_quantized_sharded_matches_unsharded():
+    """int8 params shard like their float originals (payload on the weight
+    spec, scales alongside with contracted axes cleared)."""
+    from llm_np_cp_tpu.parallel.sharding import (
+        MeshPlan, batch_spec, make_mesh, shard_params, to_shardings,
+    )
+
+    cfg = tiny_config("llama", num_attention_heads=4, num_key_value_heads=2)
+    qparams = quantize_params(init_params(jax.random.PRNGKey(5), cfg, dtype=jnp.float32))
+    plan = MeshPlan(data=2, model=2)
+    plan.validate(cfg)
+    mesh = make_mesh(plan)
+    sharded = shard_params(qparams, cfg, plan, mesh)
+    assert sharded["layers"]["q_proj"]["q"].dtype == jnp.int8
+
+    ids = jnp.asarray(
+        np.random.default_rng(5).integers(0, cfg.vocab_size, (4, 10)), jnp.int32
+    )
+    want, _ = forward(qparams, ids, cfg, None)
+    with jax.set_mesh(mesh):
+        ids_sh = jax.device_put(ids, to_shardings(mesh, batch_spec(plan)))
+        got, _ = jax.jit(lambda p, i: forward(p, i, cfg, None))(sharded, ids_sh)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-4)
+
+
 def test_quantized_generation_runs():
     from llm_np_cp_tpu.generate import Generator
     from llm_np_cp_tpu.ops.sampling import Sampler
