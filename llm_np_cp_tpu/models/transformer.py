@@ -221,11 +221,14 @@ def run_decoder_layer(
         k_att, v_att = k, v
 
     attn_weights = None
-    if attn_impl == "flash":
-        from llm_np_cp_tpu.ops.pallas.flash_attention import flash_attention
+    if attn_impl in ("flash", "ring"):
+        if attn_impl == "flash":
+            from llm_np_cp_tpu.ops.pallas.flash_attention import flash_attention as _impl_fn
+        else:
+            from llm_np_cp_tpu.parallel.ring_attention import ring_attention_ctx as _impl_fn
 
-        def _flash(window):
-            return flash_attention(
+        def _fresh_attn(window):
+            return _impl_fn(
                 q, k, v,  # current K/V: self-attention over 0..S-1
                 scale=config.attn_scale,
                 logit_softcap=config.attn_logit_softcapping,
@@ -235,11 +238,11 @@ def run_decoder_layer(
         if config.sliding_window is not None:
             attn = lax.cond(
                 sliding,
-                lambda: _flash(config.sliding_window),
-                lambda: _flash(None),
+                lambda: _fresh_attn(config.sliding_window),
+                lambda: _fresh_attn(None),
             )
         else:
-            attn = _flash(None)
+            attn = _fresh_attn(None)
     else:
         attn = gqa_attention(
             q, k_att, v_att, mask,
@@ -324,11 +327,15 @@ def forward(
         forward (llama3.2_model.py:623-624, 679-706) — a memory tax; here
         they are opt-in (SURVEY §2.6 quirks).  output_attentions requires
         the XLA attention path (the flash kernel never materializes them).
-    attn_impl: "xla" (default) or "flash" — the Pallas blockwise kernel.
-        "flash" is valid only for self-attention over positions 0..S-1
-        (fresh-cache prefill or cache-less forward with no padding); the
-        cache is still written, but attention reads the current K/V
-        directly (identical by causality since later slots are masked).
+    attn_impl: "xla" (default), "flash" (the Pallas blockwise kernel), or
+        "ring" (sequence-parallel ring attention over the ambient mesh's
+        "seq" axis — parallel/ring_attention.py; replaces the reference's
+        single-device full [S,S] score matrix, llama3.2_model.py:467-469).
+        Both non-default impls are valid only for self-attention over
+        positions 0..S-1 (fresh-cache prefill or cache-less forward with
+        no padding); the cache is still written, but attention reads the
+        current K/V directly (identical by causality since later slots
+        are masked).
 
     Returns (logits, new_cache) — logits [B, S, V] float32 (or [B, 1, V]
     when logits_last_only) — plus an aux dict with "hidden_states" /
@@ -336,14 +343,26 @@ def forward(
     """
     if output_attentions and attn_impl != "xla":
         raise ValueError("output_attentions requires attn_impl='xla'")
-    if attn_impl == "flash" and (attn_mask is not None or pad_offsets is not None):
-        # the Pallas kernel builds its causal mask from slot index alone —
-        # it cannot see per-row validity/position shifts, so ragged inputs
-        # would silently attend pad slots
-        raise ValueError(
-            "attn_impl='flash' does not support attn_mask/pad_offsets "
-            "(ragged batches); use attn_impl='xla'"
-        )
+    if attn_impl in ("flash", "ring"):
+        if attn_mask is not None or pad_offsets is not None:
+            # these kernels build their causal mask from slot index alone —
+            # they cannot see per-row validity/position shifts, so ragged
+            # inputs would silently attend pad slots
+            raise ValueError(
+                f"attn_impl={attn_impl!r} does not support attn_mask/"
+                "pad_offsets (ragged batches); use attn_impl='xla'"
+            )
+        # Fresh-cache-only contract: attention reads the freshly projected
+        # K/V, so cached history would be silently dropped.  length is
+        # traced under jit (the prefill fns pass a fresh cache by
+        # construction); enforce host-side whenever it is concrete.
+        if cache is not None and not isinstance(cache.length, jax.core.Tracer):
+            if int(cache.length) != 0:
+                raise ValueError(
+                    f"attn_impl={attn_impl!r} requires a fresh cache "
+                    f"(length 0, got {int(cache.length)}): cached history "
+                    "is not visible to these kernels"
+                )
     b, s = input_ids.shape
     act_dtype = compute_dtype(params)
 

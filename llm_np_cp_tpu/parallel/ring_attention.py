@@ -26,7 +26,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
-from llm_np_cp_tpu.parallel.sharding import SEQ_AXIS
+from llm_np_cp_tpu.parallel.sharding import DATA_AXIS, MODEL_AXIS, SEQ_AXIS
 
 NEG_INF = float(jnp.finfo(jnp.float32).min)
 
@@ -134,5 +134,64 @@ def ring_attention(
             P(None, axis_name, None, None),
         ),
         out_specs=P(None, axis_name, None, None),
+    )
+    return fn(q, k, v)
+
+
+def ring_attention_ctx(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    *,
+    scale: float,
+    logit_softcap: float | None = None,
+    window: int | None = None,
+) -> jnp.ndarray:
+    """Ring attention over the AMBIENT mesh (``jax.set_mesh``) — the entry
+    point ``models.transformer.forward`` uses for ``attn_impl="ring"``.
+
+    Composes with the rest of the forward's GSPMD shardings: the batch dim
+    stays on "data" (DP), and the head dims stay on "model" (TP) when both
+    Q and KV head counts divide the model axis — otherwise heads are
+    replicated inside the ring (correct, just not TP-local; Gemma-2's 4 KV
+    heads on an 8-way model axis hit this).  The sequence dim is sharded on
+    "seq"; each chip's K/V block rotates one hop per step over ICI.
+
+    Requires fresh positions 0..S-1 (prefill / cache-less forward), like
+    the flash path — ``forward`` enforces the boundary.
+    """
+    mesh = jax.sharding.get_abstract_mesh()
+    if SEQ_AXIS not in mesh.shape or mesh.shape[SEQ_AXIS] < 2:
+        raise ValueError(
+            "attn_impl='ring' needs an ambient mesh (jax.set_mesh) with a "
+            f"'{SEQ_AXIS}' axis of size >= 2; got mesh shape {dict(mesh.shape)}"
+        )
+    num_shards = mesh.shape[SEQ_AXIS]
+    if q.shape[1] % num_shards:
+        raise ValueError(
+            f"seq {q.shape[1]} not divisible by {SEQ_AXIS}={num_shards}"
+        )
+    d = DATA_AXIS if mesh.shape.get(DATA_AXIS, 1) > 1 else None
+    tp = mesh.shape.get(MODEL_AXIS, 1)
+    m = (
+        MODEL_AXIS
+        if tp > 1 and q.shape[2] % tp == 0 and k.shape[2] % tp == 0
+        else None
+    )
+    fn = jax.shard_map(
+        functools.partial(
+            _local_ring_attention,
+            axis_name=SEQ_AXIS,
+            num_shards=num_shards,
+            scale=scale,
+            logit_softcap=logit_softcap,
+            window=window,
+        ),
+        in_specs=(
+            P(d, SEQ_AXIS, m, None),
+            P(d, SEQ_AXIS, m, None),
+            P(d, SEQ_AXIS, m, None),
+        ),
+        out_specs=P(d, SEQ_AXIS, m, None),
     )
     return fn(q, k, v)

@@ -48,3 +48,116 @@ def test_ring_rejects_indivisible_seq(rng_np):
     kv = jnp.zeros((1, 30, 1, 8), dtype=jnp.float32)
     with pytest.raises(ValueError, match="not divisible"):
         ring_attention(x, kv, kv, mesh=mesh, scale=1.0)
+
+
+# ----------------------------------------------------------------------
+# attn_impl="ring" integrated into forward (VERDICT r1 item 3)
+# ----------------------------------------------------------------------
+
+def _tiny_cfg(**kw):
+    from llm_np_cp_tpu.config import tiny_config
+
+    return tiny_config(
+        "llama", num_attention_heads=4, num_key_value_heads=2, head_dim=8,
+        hidden_size=32, num_hidden_layers=2, **kw
+    )
+
+
+def test_forward_ring_tp_sp_parity():
+    """Cache-less forward with attn_impl='ring' on a DP×SP×TP mesh matches
+    the single-device XLA path."""
+    from llm_np_cp_tpu.models.transformer import forward, init_params
+    from llm_np_cp_tpu.parallel.sharding import shard_params
+
+    cfg = _tiny_cfg()
+    params = init_params(jax.random.PRNGKey(0), cfg, dtype=jnp.float32)
+    ids = jnp.asarray(
+        np.random.default_rng(0).integers(0, cfg.vocab_size, (2, 16)), jnp.int32
+    )
+    want, _ = forward(params, ids, cfg)
+
+    plan = MeshPlan(data=2, seq=2, model=2)
+    mesh = make_mesh(plan)
+    p_sh = shard_params(params, cfg, plan, mesh)
+    with jax.set_mesh(mesh):
+        got, _ = jax.jit(lambda p, i: forward(p, i, cfg, attn_impl="ring"))(p_sh, ids)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-4, rtol=1e-4)
+
+
+def test_forward_ring_prefill_writes_cache():
+    """Ring prefill (fresh cache) produces the same logits AND the same
+    cache contents as the XLA prefill, so decode can continue from it."""
+    from llm_np_cp_tpu.cache import KVCache
+    from llm_np_cp_tpu.models.transformer import forward, init_params
+    from llm_np_cp_tpu.parallel.sharding import (
+        MeshPlan, shard_cache, shard_params,
+    )
+
+    cfg = _tiny_cfg()
+    params = init_params(jax.random.PRNGKey(0), cfg, dtype=jnp.float32)
+    ids = jnp.asarray(
+        np.random.default_rng(1).integers(0, cfg.vocab_size, (2, 16)), jnp.int32
+    )
+    cache0 = KVCache.init(cfg, 2, 24, dtype=jnp.float32)
+    want, want_cache = forward(params, ids, cfg, cache0)
+
+    plan = MeshPlan(seq=4, model=2)
+    mesh = make_mesh(plan)
+    p_sh = shard_params(params, cfg, plan, mesh)
+    c_sh = shard_cache(KVCache.init(cfg, 2, 24, dtype=jnp.float32), cfg, plan, mesh)
+    with jax.set_mesh(mesh):
+        got, got_cache = jax.jit(
+            lambda p, i, c: forward(p, i, cfg, c, attn_impl="ring")
+        )(p_sh, ids, c_sh)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-4, rtol=1e-4)
+    np.testing.assert_allclose(
+        np.asarray(got_cache.k), np.asarray(want_cache.k), atol=2e-4, rtol=1e-4
+    )
+    assert int(got_cache.length) == int(want_cache.length)
+
+
+def test_forward_ring_gemma_sliding_parity():
+    """Ring + Gemma-2 deltas (sliding/global alternation, softcaps) match."""
+    from llm_np_cp_tpu.config import tiny_config
+    from llm_np_cp_tpu.models.transformer import forward, init_params
+    from llm_np_cp_tpu.parallel.sharding import shard_params
+
+    cfg = tiny_config(
+        "gemma2", num_attention_heads=4, num_key_value_heads=2, head_dim=8,
+        hidden_size=32, num_hidden_layers=2, sliding_window=8,
+        attn_logit_softcapping=30.0,
+    )
+    params = init_params(jax.random.PRNGKey(0), cfg, dtype=jnp.float32)
+    ids = jnp.asarray(
+        np.random.default_rng(2).integers(0, cfg.vocab_size, (1, 16)), jnp.int32
+    )
+    want, _ = forward(params, ids, cfg)
+    plan = MeshPlan(seq=4)
+    mesh = make_mesh(plan)
+    p_sh = shard_params(params, cfg, plan, mesh)
+    with jax.set_mesh(mesh):
+        got, _ = jax.jit(lambda p, i: forward(p, i, cfg, attn_impl="ring"))(p_sh, ids)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-4, rtol=1e-4)
+
+
+def test_forward_ring_rejects_used_cache():
+    from llm_np_cp_tpu.cache import KVCache
+    from llm_np_cp_tpu.models.transformer import forward, init_params
+
+    cfg = _tiny_cfg()
+    params = init_params(jax.random.PRNGKey(0), cfg, dtype=jnp.float32)
+    ids = jnp.asarray([[1, 2, 3, 4]], jnp.int32)
+    cache = KVCache.init(cfg, 1, 16, dtype=jnp.float32)
+    _, cache = forward(params, ids, cfg, cache)
+    with pytest.raises(ValueError, match="fresh cache"):
+        forward(params, ids, cfg, cache, attn_impl="ring")
+
+
+def test_forward_ring_needs_seq_mesh():
+    from llm_np_cp_tpu.models.transformer import forward, init_params
+
+    cfg = _tiny_cfg()
+    params = init_params(jax.random.PRNGKey(0), cfg, dtype=jnp.float32)
+    ids = jnp.asarray([[1, 2, 3, 4]], jnp.int32)
+    with pytest.raises(ValueError, match="seq"):
+        forward(params, ids, cfg, attn_impl="ring")
