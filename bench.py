@@ -78,6 +78,14 @@ PREFILL_CONFIGS = {
     "prefill8k_xla": dict(model="llama1b", prompt_len=8192, attn_impl="xla"),
     "prefill8k_flash": dict(model="llama1b", prompt_len=8192, attn_impl="flash"),
 }
+SPEC_CONFIGS = {
+    # batched self-speculation: bf16 target + int8 self-draft, γ=4
+    "int8_spec_bs8": dict(model="llama1b", batch=8, prompt_len=128,
+                          decode_tokens=256, gamma=4),
+    # offline smoke for the speculative measurement path
+    "smoke_spec": dict(model="tiny", batch=2, prompt_len=16, decode_tokens=8,
+                       gamma=2),
+}
 TIMEOUTS = {"llama3b_seq2048_bs8": 900, "prefill8k_xla": 600, "prefill8k_flash": 600}
 DEFAULT_TIMEOUT = 600
 PROBE_TIMEOUT = 180
@@ -130,6 +138,26 @@ def _tree_bytes(tree) -> int:
     return sum(x.size * x.dtype.itemsize for x in jax.tree.leaves(tree))
 
 
+def _chained_reps(one, seed_prompt, vocab_size, reps=3):
+    """Run ``one(prompt_host)`` reps+1 times (first is compile warmup) with
+    FRESH inputs each rep, chained through the previous output — the
+    tunneled transport dedupes repeated executions with identical live
+    inputs, so a repeated (executable, args) pair measures nothing.
+
+    ``one`` returns a result dict that includes ``"chain"``: an int derived
+    from a materialized (host) output, proving the execution completed and
+    perturbing the next prompt.  Returns the ``reps`` measured dicts.
+    """
+    carry = seed_prompt
+    out = one(carry)  # warmup: compile
+    results = []
+    for i in range(reps):
+        carry = (carry + out["chain"] + i + 1) % vocab_size
+        out = one(carry)
+        results.append(out)
+    return results
+
+
 def _measure_decode(config, params, prefill, loop, batch, prompt_len, decode_tokens, reps=3):
     """Median TTFT + aggregate decode rate over ``reps`` fresh-input runs."""
     import jax
@@ -141,7 +169,6 @@ def _measure_decode(config, params, prefill, loop, batch, prompt_len, decode_tok
     key = jax.random.PRNGKey(0)
     max_seq = prompt_len + decode_tokens + 8
     rng = np.random.default_rng(batch)
-    carry = rng.integers(0, config.vocab_size, (batch, prompt_len))
 
     def one(prompt_host):
         cache = KVCache.init(config, batch, max_seq, dtype=jnp.bfloat16)
@@ -152,18 +179,20 @@ def _measure_decode(config, params, prefill, loop, batch, prompt_len, decode_tok
         toks, cache = loop(params, tok0, cache, key, decode_tokens)
         toks_host = np.asarray(toks)
         t2 = time.perf_counter()
-        return t1 - t0, t2 - t1, toks_host
+        return {
+            "ttft": t1 - t0,
+            "rate": batch * decode_tokens / (t2 - t1),
+            "chain": int(toks_host.sum()),
+        }
 
-    _, _, toks_host = one(carry)  # warmup: compile both programs
-    ttfts, rates = [], []
-    for i in range(reps):
-        # chain inputs through the previous output so the transport cannot
-        # serve a deduped result for a repeated (executable, args) pair
-        carry = (carry + int(toks_host.sum()) + i + 1) % config.vocab_size
-        ttft, dec, toks_host = one(carry)
-        ttfts.append(ttft)
-        rates.append(batch * decode_tokens / dec)
-    return float(np.median(ttfts)), float(np.median(rates))
+    runs = _chained_reps(
+        one, rng.integers(0, config.vocab_size, (batch, prompt_len)),
+        config.vocab_size, reps,
+    )
+    return (
+        float(np.median([r["ttft"] for r in runs])),
+        float(np.median([r["rate"] for r in runs])),
+    )
 
 
 def run_decode_config(name: str) -> dict:
@@ -219,22 +248,19 @@ def run_prefill_config(name: str) -> dict:
     prefill = make_prefill_fn(config, Sampler(kind="greedy"), attn_impl=spec["attn_impl"])
     key = jax.random.PRNGKey(0)
     rng = np.random.default_rng(0)
-    carry = rng.integers(0, config.vocab_size, (1, prompt_len))
 
     def one(prompt_host):
         cache = KVCache.init(config, 1, prompt_len + 8, dtype=jnp.bfloat16)
         t0 = time.perf_counter()
         tok0, _, _ = prefill(params, jnp.asarray(prompt_host, jnp.int32), cache, key)
         out = np.asarray(tok0)
-        return time.perf_counter() - t0, out
+        return {"ttft": time.perf_counter() - t0, "chain": int(out.sum())}
 
-    _, out = one(carry)  # compile
-    ttfts = []
-    for i in range(3):
-        carry = (carry + int(out.sum()) + i + 1) % config.vocab_size
-        ttft, out = one(carry)
-        ttfts.append(ttft)
-    ttft = float(np.median(ttfts))
+    runs = _chained_reps(
+        one, rng.integers(0, config.vocab_size, (1, prompt_len)),
+        config.vocab_size,
+    )
+    ttft = float(np.median([r["ttft"] for r in runs]))
     return {
         "config": name,
         "ok": True,
@@ -242,6 +268,47 @@ def run_prefill_config(name: str) -> dict:
         "prefill_tok_s": round(prompt_len / ttft, 1),
         "prompt_len": prompt_len,
         "attn_impl": spec["attn_impl"],
+    }
+
+
+def run_spec_config(name: str) -> dict:
+    import numpy as np
+
+    from llm_np_cp_tpu.ops.sampling import Sampler
+    from llm_np_cp_tpu.speculative import SpeculativeGenerator
+
+    spec = SPEC_CONFIGS[name]
+    config, params = _build_model(spec["model"])
+    gen = SpeculativeGenerator(
+        params, config, gamma=spec["gamma"], sampler=Sampler(kind="greedy")
+    )
+    batch, prompt_len, decode_tokens = spec["batch"], spec["prompt_len"], spec["decode_tokens"]
+    rng = np.random.default_rng(0)
+
+    def one(prompt_host):
+        res = gen.generate(prompt_host, decode_tokens)
+        return {
+            "rate": res.decode_tokens_per_s,
+            "acc": res.acceptance_rate,
+            "chain": int(res.tokens.sum()),
+        }
+
+    runs = _chained_reps(
+        one, rng.integers(0, config.vocab_size, (batch, prompt_len)),
+        config.vocab_size,
+    )
+    rates = [r["rate"] for r in runs]
+    acc = [r["acc"] for r in runs]
+    return {
+        "config": name,
+        "ok": True,
+        "decode_tok_s_chip": round(float(np.median(rates)), 1),
+        "per_seq_tok_s": round(float(np.median(rates)) / batch, 1),
+        "acceptance_rate": round(float(np.median(acc)), 3),
+        "gamma": spec["gamma"],
+        "batch": batch,
+        "prompt_len": prompt_len,
+        "decode_tokens": decode_tokens,
     }
 
 
@@ -270,6 +337,8 @@ def child_main(mode: str) -> None:
         out = run_decode_config(mode)
     elif mode in PREFILL_CONFIGS:
         out = run_prefill_config(mode)
+    elif mode in SPEC_CONFIGS:
+        out = run_spec_config(mode)
     else:
         raise SystemExit(f"unknown config {mode!r}")
     print(json.dumps(out), flush=True)
@@ -358,7 +427,9 @@ def main() -> None:
         return
 
     names = args.configs or [
-        n for n in list(DECODE_CONFIGS) + list(PREFILL_CONFIGS) if n != "smoke_tiny"
+        n
+        for n in list(DECODE_CONFIGS) + list(SPEC_CONFIGS) + list(PREFILL_CONFIGS)
+        if not n.startswith("smoke")
     ]
     detail: dict[str, dict] = {}
     for name in names:

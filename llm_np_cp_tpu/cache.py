@@ -7,7 +7,10 @@ TPU-native cache is a fixed-size pytree:
 
     k, v: [num_layers, batch, max_seq, num_kv_heads, head_dim]
     length: int32 scalar — number of tokens written (the reference's
-        ``num_items()``, llama3.2_model.py:308-312)
+        ``num_items()``, llama3.2_model.py:308-312) — or an int32 [B]
+        vector of PER-ROW lengths (batched speculative decoding, where
+        each row accepts a different number of draft tokens per round;
+        writes become per-row dynamic_update_slices via vmap).
 
 Updates are ``lax.dynamic_update_slice`` at the current offset: O(new
 tokens), jit-traceable, donate-able.  The leading layer axis exists so the
@@ -75,13 +78,18 @@ def truncate(cache: KVCache, new_length: jnp.ndarray) -> KVCache:
     them and attention (which masks on slot validity + position) never
     reads them.  O(1); the rollback primitive speculative decoding needs
     to discard rejected draft tokens.
+
+    new_length: int32 scalar, or [B] for per-row rollback (each batch row
+    keeps a different number of accepted tokens).
     """
-    keep = jnp.arange(cache.max_seq_len, dtype=jnp.int32)[None, :] < new_length
+    new_length = jnp.asarray(new_length, jnp.int32)
+    bound = new_length[:, None] if new_length.ndim == 1 else new_length
+    keep = jnp.arange(cache.max_seq_len, dtype=jnp.int32)[None, :] < bound
     return KVCache(
         k=cache.k,
         v=cache.v,
         valid=cache.valid & keep,
-        length=new_length.astype(jnp.int32),
+        length=new_length,
     )
 
 
@@ -95,8 +103,10 @@ def update_layer(
     """Write new keys/values at ``offset`` along the seq axis.
 
     k_layer/v_layer: [B, S_max, K, D]; k_new/v_new: [B, S_new, K, D];
-    offset: int32 scalar (tokens already in the cache).  Replaces the
-    reference's per-layer concat append (llama3.2_model.py:321-330).
+    offset: int32 scalar (tokens already in the cache) or [B] per-row
+    offsets (each row writes at its own length — vmapped update, the
+    batched-speculative path).  Replaces the reference's per-layer concat
+    append (llama3.2_model.py:321-330).
 
     Overflow contract: if ``offset + S_new > S_max`` the update start is
     silently clamped by ``dynamic_update_slice`` (XLA semantics — no
@@ -106,6 +116,16 @@ def update_layer(
     k_new = k_new.astype(k_layer.dtype)
     v_new = v_new.astype(v_layer.dtype)
     zero = jnp.zeros((), dtype=jnp.int32)
+    if offset.ndim == 1:
+        import jax
+
+        def one(kl, vl, kn, vn, off):
+            return (
+                lax.dynamic_update_slice(kl, kn, (off, zero, zero)),
+                lax.dynamic_update_slice(vl, vn, (off, zero, zero)),
+            )
+
+        return jax.vmap(one)(k_layer, v_layer, k_new, v_new, offset)
     k_layer = lax.dynamic_update_slice(k_layer, k_new, (zero, offset, zero, zero))
     v_layer = lax.dynamic_update_slice(v_layer, v_new, (zero, offset, zero, zero))
     return k_layer, v_layer

@@ -23,6 +23,7 @@ from __future__ import annotations
 
 import dataclasses
 import time
+from functools import partial
 from typing import Any, Callable, Iterator
 
 import jax
@@ -67,10 +68,18 @@ def make_prefill_fn(
     """(params, prompt_ids, cache, key) → (first_token [B], cache, logits).
 
     attn_impl="flash" routes prefill attention through the Pallas kernel
-    (valid here: prefill always starts from a fresh cache, offset 0).
+    (valid here: prefill always starts from a fresh cache, offset 0);
+    "ring" routes it through sequence-parallel ring attention (needs an
+    ambient mesh with a "seq" axis — parallel/ring_attention.py).
+
+    The cache argument is DONATED: it is the largest live buffer (layers ×
+    batch × max_seq × kv_heads × head_dim) and every call rebinds it, so
+    XLA updates the slabs in place instead of allocating a second copy —
+    free HBM headroom at bs=32 / long context.  Callers must not reuse the
+    input cache object after the call (all in-repo callers rebind).
     """
 
-    @jax.jit
+    @partial(jax.jit, donate_argnums=(2,))
     def prefill(
         params: Params,
         prompt_ids: jnp.ndarray,
@@ -91,9 +100,10 @@ def make_prefill_fn(
 
 
 def make_decode_step_fn(config: ModelConfig, sampler: Sampler) -> Callable:
-    """(params, tok [B], cache, key) → (next_tok [B], cache) — one token."""
+    """(params, tok [B], cache, key) → (next_tok [B], cache) — one token.
+    The cache is donated (updated in place); callers rebind it."""
 
-    @jax.jit
+    @partial(jax.jit, donate_argnums=(2,))
     def step(params: Params, tok: jnp.ndarray, cache: KVCache, key: jax.Array):
         logits, cache = forward(
             params, tok[:, None], config, cache, logits_last_only=True
@@ -115,9 +125,7 @@ def make_decode_loop_fn(
     """
     stops = jnp.asarray(stop_tokens, dtype=jnp.int32) if stop_tokens else None
 
-    from functools import partial
-
-    @partial(jax.jit, static_argnums=(4,))
+    @partial(jax.jit, static_argnums=(4,), donate_argnums=(2,))
     def decode_loop(
         params: Params,
         first_tok: jnp.ndarray,

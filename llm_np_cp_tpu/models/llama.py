@@ -29,6 +29,16 @@ LAYER_KEY_MAP: dict[str, tuple[str, bool]] = {
     "mlp.gate_proj.weight": ("gate_proj", True),
     "mlp.up_proj.weight": ("up_proj", True),
     "mlp.down_proj.weight": ("down_proj", True),
+    # bias entries are consulted only when the config declares
+    # attention_bias / mlp_bias (the loader's host buffers come from
+    # param_shapes, which gates on those flags); 1-D, never transposed
+    "self_attn.q_proj.bias": ("q_bias", False),
+    "self_attn.k_proj.bias": ("k_bias", False),
+    "self_attn.v_proj.bias": ("v_bias", False),
+    "self_attn.o_proj.bias": ("o_bias", False),
+    "mlp.gate_proj.bias": ("gate_bias", False),
+    "mlp.up_proj.bias": ("up_bias", False),
+    "mlp.down_proj.bias": ("down_bias", False),
 }
 
 TOP_KEY_MAP: dict[str, tuple[str, bool]] = {

@@ -65,6 +65,17 @@ def param_shapes(config: ModelConfig) -> dict[str, Any]:
         "o_proj": (L, NH * D, H),
         "ln_mlp_in": (L, H),
     }
+    if config.attention_bias:
+        # HF Llama-family attention_bias puts a bias on all four attention
+        # projections (Qwen-2-style checkpoints)
+        layers.update(
+            q_bias=(L, NH * D), k_bias=(L, NK * D),
+            v_bias=(L, NK * D), o_bias=(L, H),
+        )
+    if config.mlp_bias:
+        if config.is_moe:
+            raise NotImplementedError("mlp_bias is not supported for MoE configs")
+        layers.update(gate_bias=(L, I), up_bias=(L, I), down_bias=(L, H))
     if config.is_moe:
         E = config.num_local_experts
         layers.update(
@@ -97,22 +108,25 @@ def init_params(
 ) -> Params:
     """Random small-scale init (for tests and synthetic benchmarks)."""
     spec = param_shapes(config)
-    leaves, treedef = jax.tree.flatten(spec, is_leaf=lambda x: isinstance(x, tuple))
-    keys = jax.random.split(rng, len(leaves))
+    paths_leaves, treedef = jax.tree_util.tree_flatten_with_path(
+        spec, is_leaf=lambda x: isinstance(x, tuple)
+    )
+    keys = jax.random.split(rng, len(paths_leaves))
 
-    def make(key: jax.Array, shape: tuple[int, ...]) -> jnp.ndarray:
-        if len(shape) <= 2 and shape[-1] == config.hidden_size:
+    def make(key: jax.Array, path: tuple, shape: tuple[int, ...]) -> jnp.ndarray:
+        name = path[-1].key  # leaf name in the dict pytree
+        if name.startswith("ln_") or name == "final_norm":
             # norm gammas: zeros under unit-offset (so 1+w == 1), ones otherwise
-            if shape == (config.num_hidden_layers, config.hidden_size) or shape == (
-                config.hidden_size,
-            ):
-                init = 0.0 if config.rms_norm_unit_offset else 1.0
-                return jnp.full(shape, init, dtype=dtype)
+            init = 0.0 if config.rms_norm_unit_offset else 1.0
+            return jnp.full(shape, init, dtype=dtype)
+        if name.endswith("_bias"):
+            # biases start small-but-nonzero so tests exercise the add path
+            return (jax.random.normal(key, shape, jnp.float32) * 0.02).astype(dtype)
         scale = 0.02
         return (jax.random.normal(key, shape, dtype=jnp.float32) * scale).astype(dtype)
 
     return jax.tree.unflatten(
-        treedef, [make(k, s) for k, s in zip(keys, leaves)]
+        treedef, [make(k, p, s) for k, (p, s) in zip(keys, paths_leaves)]
     )
 
 
@@ -209,9 +223,14 @@ def run_decoder_layer(
         x, w["ln_attn_in"], eps=config.rms_norm_eps,
         unit_offset=config.rms_norm_unit_offset,
     )
-    q = _project(h, w["q_proj"]).reshape(b, s, config.num_attention_heads, config.head_dim)
-    k = _project(h, w["k_proj"]).reshape(b, s, config.num_key_value_heads, config.head_dim)
-    v = _project(h, w["v_proj"]).reshape(b, s, config.num_key_value_heads, config.head_dim)
+    def _proj_b(x, wname):
+        y = _project(x, w[wname])
+        bias = w.get(wname.replace("_proj", "_bias"))
+        return y + bias.astype(y.dtype) if bias is not None else y
+
+    q = _proj_b(h, "q_proj").reshape(b, s, config.num_attention_heads, config.head_dim)
+    k = _proj_b(h, "k_proj").reshape(b, s, config.num_key_value_heads, config.head_dim)
+    v = _proj_b(h, "v_proj").reshape(b, s, config.num_key_value_heads, config.head_dim)
     q = apply_rope(q, cos, sin)
     k = apply_rope(k, cos, sin)
 
@@ -253,6 +272,8 @@ def run_decoder_layer(
         if output_attentions:
             attn, attn_weights = attn
     attn = _project(attn.reshape(b, s, -1), w["o_proj"])
+    if "o_bias" in w:
+        attn = attn + w["o_bias"].astype(attn.dtype)
     if config.sandwich_norms:
         attn = rms_norm(
             attn, w["ln_attn_out"], eps=config.rms_norm_eps,
@@ -275,9 +296,9 @@ def run_decoder_layer(
             group_size=config.moe_group_size,
         )
     else:
-        gate = act(_project(h, w["gate_proj"]))
-        up = _project(h, w["up_proj"])
-        mlp = _project(gate * up, w["down_proj"])
+        gate = act(_proj_b(h, "gate_proj"))
+        up = _proj_b(h, "up_proj")
+        mlp = _proj_b(gate * up, "down_proj")
     if config.sandwich_norms:
         mlp = rms_norm(
             mlp, w["ln_mlp_out"], eps=config.rms_norm_eps,
@@ -366,9 +387,11 @@ def forward(
     b, s = input_ids.shape
     act_dtype = compute_dtype(params)
 
+    # offset: scalar, or [B] per-row lengths (batched speculative decoding)
     offset = cache.length if cache is not None else jnp.zeros((), jnp.int32)
     if positions is None:
-        positions = offset + jnp.arange(s, dtype=jnp.int32)[None, :]
+        off_rows = offset[:, None] if offset.ndim == 1 else offset
+        positions = off_rows + jnp.arange(s, dtype=jnp.int32)[None, :]
         positions = jnp.broadcast_to(positions, (b, s))
         if pad_offsets is not None:
             # left-padded ragged rows: clamp so pad slots get position 0
@@ -393,9 +416,14 @@ def forward(
             if attn_mask is not None
             else jnp.ones((b, s), dtype=jnp.bool_)
         )
-        cache_valid = lax.dynamic_update_slice(
-            cache.valid, new_tokens_valid, (jnp.zeros((), jnp.int32), offset)
-        )
+        if offset.ndim == 1:
+            cache_valid = jax.vmap(
+                lambda row, new, off: lax.dynamic_update_slice(row, new, (off,))
+            )(cache.valid, new_tokens_valid, offset)
+        else:
+            cache_valid = lax.dynamic_update_slice(
+                cache.valid, new_tokens_valid, (jnp.zeros((), jnp.int32), offset)
+            )
         kv_valid = cache_valid
     else:
         kv_positions = positions

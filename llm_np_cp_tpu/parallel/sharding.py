@@ -130,6 +130,17 @@ def param_specs(config: ModelConfig, plan: MeshPlan) -> dict[str, Any]:
         "up_proj": P(pp, None, m),
         "down_proj": P(pp, m, None),
     }
+    if config.attention_bias:
+        # biases follow their projection's output sharding; o_bias is added
+        # after the row-parallel psum, so it stays replicated on "model"
+        layers["q_bias"] = P(pp, m)
+        layers["k_bias"] = P(pp, kv)
+        layers["v_bias"] = P(pp, kv)
+        layers["o_bias"] = P(pp, None)
+    if config.mlp_bias:
+        layers["gate_bias"] = P(pp, m)
+        layers["up_bias"] = P(pp, m)
+        layers["down_bias"] = P(pp, None)
     if config.is_moe:
         # expert weights [L, E, ...]: experts on "expert", feature dims on
         # "model" (EP × TP compose); the tiny router stays replicated

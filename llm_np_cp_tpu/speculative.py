@@ -47,13 +47,19 @@ Params = dict[str, Any]
 
 @dataclasses.dataclass
 class SpecResult:
-    tokens: np.ndarray  # [num_generated]
+    tokens: np.ndarray  # [num_generated] (1-D prompt) or [B, num_generated]
     ttft_s: float
-    decode_tokens_per_s: float
+    decode_tokens_per_s: float  # aggregate over rows (== per-seq at bs=1)
     num_generated: int
     rounds: int
-    acceptance_rate: float  # accepted draft tokens / proposed draft tokens
-    tokens_per_round: float
+    acceptance_rate: float  # accepted draft tokens / proposed (active rows)
+    tokens_per_round: float  # mean per active row
+
+
+def _as_rows(length: jnp.ndarray, batch: int) -> jnp.ndarray:
+    """Cache length as per-row [B] (broadcasting a scalar on first use)."""
+    length = jnp.asarray(length, jnp.int32)
+    return jnp.broadcast_to(length, (batch,)) if length.ndim == 0 else length
 
 
 def _spec_round_core(
@@ -69,11 +75,29 @@ def _spec_round_core(
     gamma: int,
     sampler: Sampler,
     draft_sampler: Sampler,
+    active: jnp.ndarray | None = None,
 ):
-    """Traced body of one speculative round (batch 1) — see module doc."""
+    """Traced body of one speculative round, batched over rows.
+
+    t0: [B] int32 — the verified input token per row.  Every row drafts γ
+    tokens and verifies them in one target forward; each row accepts its
+    own prefix length n_b, and the caches roll back PER ROW (vector
+    ``length`` — cache.truncate/update_layer handle [B] offsets), so rows
+    at different acceptance rates advance independently.
+
+    active: optional [B] bool — rows that already finished (hit a stop
+    token / budget) are frozen: their count is 0 and their cache rows roll
+    back to where they started, so they burn no capacity.
+
+    Returns (emitted [B, γ+1] (first count_b real per row), count [B],
+    dcache, tcache, next_t0 [B]).
+    """
+    b = t0.shape[0]
     kd, ku, kc = jax.random.split(key, 3)
-    t_base = tcache.length
-    d_base = dcache.length
+    t_base = _as_rows(tcache.length, b)
+    d_base = _as_rows(dcache.length, b)
+    tcache = tcache._replace(length=t_base)
+    dcache = dcache._replace(length=d_base)
 
     # --- draft: γ+1 steps (the extra step's proposal is discarded but
     # leaves the draft cache covering every verified input, so the
@@ -83,47 +107,54 @@ def _spec_round_core(
         logits, dc = forward(
             draft_params, tok[:, None], draft_config, dc, logits_last_only=True
         )
-        fl = draft_sampler.filtered_logits(logits[:, -1])  # [1, V]
+        fl = draft_sampler.filtered_logits(logits[:, -1])  # [B, V]
         nxt = jax.random.categorical(k, fl, axis=-1).astype(jnp.int32)
-        return (nxt, dc), (nxt[0], jax.nn.softmax(fl[0], axis=-1))
+        return (nxt, dc), (nxt, jax.nn.softmax(fl, axis=-1))
 
     dkeys = jax.random.split(kd, gamma + 1)
     (_, dcache2), (drafts, qprobs) = lax.scan(dstep, (t0, dcache), dkeys)
-    d = drafts[:gamma]  # proposals d_1..d_γ
+    d = jnp.moveaxis(drafts[:gamma], 0, 1)  # [B, γ] proposals d_1..d_γ
+    qp = jnp.moveaxis(qprobs, 0, 1)  # [B, γ+1, V]
 
     # --- target: verify all proposals in one forward
-    inp = jnp.concatenate([t0, d])[None, :]  # [1, γ+1]
+    inp = jnp.concatenate([t0[:, None], d], axis=1)  # [B, γ+1]
     tlogits, tcache2 = forward(target_params, inp, target_config, tcache)
-    p = jax.nn.softmax(sampler.filtered_logits(tlogits[0]), axis=-1)  # [γ+1, V]
+    p = jax.nn.softmax(sampler.filtered_logits(tlogits), axis=-1)  # [B, γ+1, V]
 
     # --- accept/reject (multiplied form avoids div-by-zero; q(d) > 0
     # by construction since d was sampled from q)
-    idx = jnp.arange(gamma)
-    p_d = p[idx, d]
-    q_d = qprobs[idx, d]
-    u = jax.random.uniform(ku, (gamma,), dtype=jnp.float32)
-    accept = u * q_d < p_d
-    n = jnp.where(jnp.all(accept), gamma, jnp.argmin(accept))
+    p_d = jnp.take_along_axis(p[:, :gamma], d[..., None], axis=-1)[..., 0]
+    q_d = jnp.take_along_axis(qp[:, :gamma], d[..., None], axis=-1)[..., 0]
+    u = jax.random.uniform(ku, (b, gamma), dtype=jnp.float32)
+    accept = u * q_d < p_d  # [B, γ]
+    n = jnp.where(
+        jnp.all(accept, axis=-1), gamma, jnp.argmin(accept, axis=-1)
+    )  # [B]
 
     # --- correction (n < γ: residual norm(max(p−q, 0))) or bonus
-    # (n == γ: plain p) — unified by a zero row AT position γ (qprobs has
+    # (n == γ: plain p) — unified by a zero row AT position γ (qp has
     # γ+1 rows; its last row is the discarded extra draft step's
     # distribution and must NOT leak into the bonus sample)
-    q_pad = jnp.concatenate(
-        [qprobs[:gamma], jnp.zeros((1,) + qprobs.shape[1:])]
-    )
-    residual = jnp.maximum(p[n] - q_pad[n], 0.0)
-    total = jnp.sum(residual)
-    dist = jnp.where(total > 0, residual / jnp.maximum(total, 1e-38), p[n])
+    q_pad = qp.at[:, gamma].set(0.0)
+    sel = lambda a: jnp.take_along_axis(a, n[:, None, None], axis=1)[:, 0]  # [B, V]
+    residual = jnp.maximum(sel(p) - sel(q_pad), 0.0)
+    total = jnp.sum(residual, axis=-1, keepdims=True)
+    dist = jnp.where(total > 0, residual / jnp.maximum(total, 1e-38), sel(p))
     c = jax.random.categorical(kc, jnp.log(dist + 1e-38), axis=-1).astype(jnp.int32)
 
-    emitted = jnp.concatenate([d, jnp.zeros((1,), jnp.int32)]).at[n].set(c)
-    count = n + 1
+    emitted = jnp.concatenate(
+        [d, jnp.zeros((b, 1), jnp.int32)], axis=1
+    ).at[jnp.arange(b), n].set(c)
+    count = n + 1  # [B]
+    next_t0 = c
+    if active is not None:
+        count = jnp.where(active, count, 0)
+        next_t0 = jnp.where(active, next_t0, t0)
 
-    # --- roll both caches back to the accepted inputs t0..d_n
+    # --- roll both caches back to the accepted inputs t0..d_n, per row
     tcache2 = truncate(tcache2, t_base + count)
     dcache2 = truncate(dcache2, d_base + count)
-    return emitted, count, dcache2, tcache2, c[None]
+    return emitted, count, dcache2, tcache2, next_t0
 
 
 def make_spec_round_fn(
@@ -135,9 +166,13 @@ def make_spec_round_fn(
 ):
     """One jitted speculative round (granular API; one dispatch per round).
 
-    (draft_params, target_params, t0 [1], dcache, tcache, key) →
-    (emitted [γ+1] (only the first ``count`` are real), count, dcache,
-    tcache, next_t0 [1]).
+    (draft_params, target_params, t0 [B], dcache, tcache, key) →
+    (emitted [B, γ+1] (only the first ``count_b`` of each row are real),
+    count [B], dcache, tcache, next_t0 [B]).
+
+    Both caches are DONATED (updated in place); callers must rebind them
+    from the return value and never reuse the inputs.  Cache ``length``
+    comes back as a per-row [B] vector from the first round on.
     """
     from functools import partial
 
@@ -149,7 +184,8 @@ def make_spec_round_fn(
             gamma=gamma,
             sampler=sampler,
             draft_sampler=draft_sampler or sampler,
-        )
+        ),
+        donate_argnums=(3, 4),  # both caches update in place; callers rebind
     )
 
 
@@ -164,18 +200,22 @@ def make_spec_decode_fn(
     """The fused loop: ALL speculative rounds in one ``lax.while_loop`` —
     a single device dispatch for the whole generation (per-round host
     sync costs a full transport RTT on a tunneled chip, same reason
-    generate.py fuses its decode scan).
+    generate.py fuses its decode scan).  Batched: rows accept draft
+    prefixes independently (per-row cache lengths); rows that hit their
+    budget or a stop token freeze (count 0, caches pinned) while the rest
+    keep going, and the loop ends when every row is done.
 
-    (draft_params, target_params, t0 [1], dcache, tcache, key, max_new) →
-    (buf [max_new+γ+1] (first ``total`` real, t0 included), total,
-    rounds, accepted, dcache, tcache).
+    (draft_params, target_params, t0 [B], dcache, tcache, key, max_new) →
+    (buf [B, max_new+γ+1] (first ``total_b`` real per row, t0 included),
+    total [B], rounds [B] (rounds each row was ACTIVE in), accepted,
+    proposed (scalars, summed over active rows), dcache, tcache).
     """
     from functools import partial
 
     draft_sampler_ = draft_sampler or sampler
     stops = jnp.asarray(stop_tokens, dtype=jnp.int32) if stop_tokens else None
 
-    @partial(jax.jit, static_argnums=(6,))
+    @partial(jax.jit, static_argnums=(6,), donate_argnums=(3, 4))
     def spec_decode(
         draft_params: Params,
         target_params: Params,
@@ -185,57 +225,79 @@ def make_spec_decode_fn(
         key: jax.Array,
         max_new: int,
     ):
-        buf = jnp.zeros((max_new + gamma + 1,), jnp.int32).at[0].set(t0[0])
+        b = t0.shape[0]
+        # per-row lengths from round one, so the while-carry type is stable
+        dcache = dcache._replace(length=_as_rows(dcache.length, b))
+        tcache = tcache._replace(length=_as_rows(tcache.length, b))
+        buf = jnp.zeros((b, max_new + gamma + 1), jnp.int32).at[:, 0].set(t0)
         done0 = (
-            jnp.any(t0[0] == stops) if stops is not None else jnp.array(False)
+            jnp.any(t0[:, None] == stops[None, :], axis=-1)
+            if stops is not None
+            else jnp.zeros((b,), jnp.bool_)
         )
         state = (
-            jnp.ones((), jnp.int32),  # total emitted (t0 included)
+            jnp.ones((b,), jnp.int32),  # total emitted per row (t0 included)
             done0,
             t0,
             dcache,
             tcache,
             key,
             buf,
-            jnp.zeros((), jnp.int32),  # rounds
-            jnp.zeros((), jnp.int32),  # accepted draft tokens
+            jnp.zeros((b,), jnp.int32),  # rounds each row was active in
+            jnp.zeros((), jnp.int32),  # accepted draft tokens (active rows)
+            jnp.zeros((), jnp.int32),  # proposed draft tokens (active rows)
         )
 
         def cond(state):
             total, done = state[0], state[1]
-            return (total < max_new) & ~done
+            return jnp.any((total < max_new) & ~done)
 
         def body(state):
-            total, done, t, dcache, tcache, key, buf, rounds, accepted = state
+            (total, done, t, dcache, tcache, key, buf, rounds, accepted,
+             proposed) = state
             key, kr = jax.random.split(key)
+            active = (total < max_new) & ~done
             emitted, count, dcache, tcache, t = _spec_round_core(
                 draft_params, target_params, t, dcache, tcache, kr,
                 draft_config=draft_config, target_config=target_config,
                 gamma=gamma, sampler=sampler, draft_sampler=draft_sampler_,
+                active=active,
             )
-            # write the whole γ+1 window; slots past `count` are garbage the
-            # next round overwrites (buf is oversized by γ+1 for the tail)
-            buf = lax.dynamic_update_slice(buf, emitted, (total,))
+            # write the whole γ+1 window at each row's total; slots past
+            # `count_b` are garbage overwritten next round (buf is oversized
+            # by γ+1 for the tail; frozen rows write only past their data)
+            buf = jax.vmap(
+                lambda row, em, tot: lax.dynamic_update_slice(row, em, (tot,))
+            )(buf, emitted, total)
             if stops is not None:
-                real = jnp.arange(gamma + 1) < count
+                real = jnp.arange(gamma + 1)[None, :] < count[:, None]
                 done = done | jnp.any(
-                    real[:, None] & (emitted[:, None] == stops[None, :])
+                    real[:, :, None]
+                    & (emitted[:, :, None] == stops[None, None, :]),
+                    axis=(1, 2),
                 )
             return (
                 total + count, done, t, dcache, tcache, key, buf,
-                rounds + 1, accepted + count - 1,
+                rounds + active.astype(jnp.int32),
+                accepted + jnp.sum(jnp.maximum(count - 1, 0)),
+                proposed + gamma * jnp.sum(active.astype(jnp.int32)),
             )
 
-        total, _, _, dcache, tcache, _, buf, rounds, accepted = lax.while_loop(
-            cond, body, state
+        (total, _, _, dcache, tcache, _, buf, rounds, accepted, proposed) = (
+            lax.while_loop(cond, body, state)
         )
-        return buf, total, rounds, accepted, dcache, tcache
+        return buf, total, rounds, accepted, proposed, dcache, tcache
 
     return spec_decode
 
 
 class SpeculativeGenerator:
-    """Owns the jitted prefill + spec-round programs (batch size 1).
+    """Owns the jitted prefill + spec-round programs.
+
+    Batched: a [B, S] prompt runs B speculative streams in one program —
+    rows accept draft prefixes independently via per-row cache lengths
+    (cache.py vector ``length``), so a slow row never rolls back a fast
+    one.  1-D prompts keep the original batch-1 surface.
 
     draft defaults to the int8-quantized target params (self-speculation);
     pass ``draft_params``/``draft_config`` for a separate small model
@@ -292,45 +354,70 @@ class SpeculativeGenerator:
         seed: int = 0,
         stop_tokens: tuple[int, ...] = (),
     ) -> SpecResult:
-        prompt_ids = jnp.asarray(prompt_ids, dtype=jnp.int32).reshape(1, -1)
-        s = prompt_ids.shape[1]
+        prompt_ids = jnp.asarray(prompt_ids, dtype=jnp.int32)
+        squeeze = prompt_ids.ndim == 1
+        if squeeze:
+            prompt_ids = prompt_ids[None, :]
+        b, s = prompt_ids.shape
         # rounds overshoot by up to γ+1 tokens before rollback trims them
         max_seq_len = max_seq_len or s + max_new_tokens + self.gamma + 1
         _check_capacity(s, max_new_tokens + self.gamma + 1, max_seq_len)
 
         key = jax.random.PRNGKey(seed)
         key, kp = jax.random.split(key)
-        tcache = KVCache.init(self.config, 1, max_seq_len, dtype=self.cache_dtype)
-        dcache = KVCache.init(self.draft_config, 1, max_seq_len, dtype=self.cache_dtype)
+        tcache = KVCache.init(self.config, b, max_seq_len, dtype=self.cache_dtype)
+        dcache = KVCache.init(self.draft_config, b, max_seq_len, dtype=self.cache_dtype)
 
         t0_wall = time.perf_counter()
         tok, tcache, _ = self._prefill_t(self.params, prompt_ids, tcache, kp)
         _, dcache, _ = self._prefill_d(self.draft_params, prompt_ids, dcache, kp)
-        int(tok[0])  # force
+        # force BOTH prefills (draft included) so its cost lands in TTFT,
+        # not in the decode timer
+        np.asarray(tok)
+        np.asarray(dcache.length)
         ttft = time.perf_counter() - t0_wall
 
         # the whole speculative loop is ONE dispatch (lax.while_loop)
         t_dec = time.perf_counter()
-        buf, total, rounds, accepted, dcache, tcache = self._loop(stop_tokens)(
+        buf, total, rounds, accepted, proposed, dcache, tcache = self._loop(
+            stop_tokens
+        )(
             self.draft_params, self.params, tok, dcache, tcache, key,
             max_new_tokens,
         )
         buf = np.asarray(buf)  # forces completion (D2H)
         decode_s = time.perf_counter() - t_dec
-        total, rounds, accepted = int(total), int(rounds), int(accepted)
+        total = np.asarray(total)
+        rounds_b = np.asarray(rounds)
+        accepted, proposed = int(accepted), int(proposed)
 
-        tokens = buf[: min(total, max_new_tokens)].astype(np.int32)
+        tokens = buf[:, :max_new_tokens].astype(np.int32)
+        # rate over the tokens actually RETURNED (the final round can
+        # overshoot max_new_tokens by up to γ per row; those are trimmed
+        # and must not inflate the reported rate)
+        n_dec_b = np.minimum(total, max_new_tokens) - 1
+        n_dec = int(n_dec_b.sum())
         if stop_tokens:
-            hits = np.isin(tokens, stop_tokens).nonzero()[0]
-            if hits.size:
-                tokens = tokens[: hits[0] + 1]
-        n_dec = total - 1  # tokens produced after the prefill token
+            from llm_np_cp_tpu.generate import _trim_after_stop
+
+            tokens = _trim_after_stop(tokens, tuple(stop_tokens))
+        if squeeze:
+            tokens = tokens[0]
+            if stop_tokens:
+                hits = np.isin(tokens, stop_tokens).nonzero()[0]
+                if hits.size:
+                    tokens = tokens[: hits[0] + 1]
+        act = rounds_b > 0
         return SpecResult(
             tokens=tokens,
             ttft_s=ttft,
             decode_tokens_per_s=n_dec / decode_s if decode_s > 0 else float("nan"),
-            num_generated=len(tokens),
-            rounds=rounds,
-            acceptance_rate=accepted / (rounds * self.gamma) if rounds else 0.0,
-            tokens_per_round=n_dec / rounds if rounds else 0.0,
+            num_generated=tokens.shape[-1],
+            rounds=int(rounds_b.max()),
+            acceptance_rate=accepted / proposed if proposed else 0.0,
+            # mean over rows of (tokens the row emitted / rounds it was
+            # active in) — rows finishing early don't deflate the metric
+            tokens_per_round=(
+                float(np.mean(n_dec_b[act] / rounds_b[act])) if act.any() else 0.0
+            ),
         )

@@ -38,6 +38,13 @@ def hf_tensors(params_np, model_type):
         )
     else:
         lnames["ln_mlp_in"] = "post_attention_layernorm.weight"
+    for bname in (
+        "q_bias", "k_bias", "v_bias", "o_bias",
+        "gate_bias", "up_bias", "down_bias",
+    ):
+        if bname in params_np["layers"]:
+            mod = "self_attn" if bname[0] in "qkvo" else "mlp"
+            lnames[bname] = f"{mod}.{bname.replace('_bias', '_proj')}.bias"
     n_layers = params_np["layers"]["q_proj"].shape[0]
     for name, hf_suffix in lnames.items():
         stacked = params_np["layers"][name]
