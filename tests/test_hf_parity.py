@@ -1,0 +1,191 @@
+"""End-to-end parity against HuggingFace transformers (SURVEY §7 step 2).
+
+A tiny random checkpoint is written in EXACT HF layout (config.json +
+safetensors + tokenizer files) and driven three ways:
+
+1. logits parity: our loader+forward vs ``AutoModelForCausalLM`` on CPU —
+   pins the oracle to HF instead of to itself (llama, llama+biases,
+   gemma-2 with its softcaps/sandwich norms);
+2. the full CLI path (``cli.run``) on both backends over the on-disk
+   checkpoint, greedy — byte-identical text between the jax path and the
+   NumPy oracle;
+3. tokenizer round-trip through the same files AutoTokenizer reads.
+
+Reference being pinned: the reference validates nothing (its numpy/cupy
+twins only cross-check each other, llama3.2_model.py vs
+llama3.2_model_numpy.py); BASELINE.md north star asks for 1e-3 logits
+parity.
+"""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+transformers = pytest.importorskip("transformers")
+torch = pytest.importorskip("torch")
+
+from llm_np_cp_tpu.models.transformer import forward
+from llm_np_cp_tpu.utils.loading import load_params
+
+TINY = dict(
+    vocab_size=256,
+    hidden_size=32,
+    intermediate_size=64,
+    num_hidden_layers=2,
+    num_attention_heads=4,
+    num_key_value_heads=2,
+    head_dim=8,
+    max_position_embeddings=128,
+)
+
+
+def _save_hf_llama(tmp_path, **overrides):
+    cfg = transformers.LlamaConfig(
+        **TINY, rope_theta=10000.0, rms_norm_eps=1e-5,
+        tie_word_embeddings=True, **overrides,
+    )
+    torch.manual_seed(0)
+    model = transformers.LlamaForCausalLM(cfg).eval()
+    model.save_pretrained(tmp_path, safe_serialization=True)
+    return model
+
+
+def _save_hf_gemma2(tmp_path):
+    cfg = transformers.Gemma2Config(
+        **TINY,
+        head_dim=8,
+        query_pre_attn_scalar=8.0,
+        final_logit_softcapping=30.0,
+        attn_logit_softcapping=50.0,
+        sliding_window=16,
+        rope_theta=10000.0,
+        rms_norm_eps=1e-6,
+        tie_word_embeddings=True,
+        hidden_activation="gelu_pytorch_tanh",
+    )
+    torch.manual_seed(0)
+    model = transformers.Gemma2ForCausalLM(cfg).eval()
+    model.save_pretrained(tmp_path, safe_serialization=True)
+    return model
+
+
+def _ids(n=12, seed=0):
+    return np.random.default_rng(seed).integers(4, TINY["vocab_size"], (1, n))
+
+
+def _assert_logits_match(tmp_path, hf_model, ids, atol=2e-3):
+    params, cfg = load_params(tmp_path, dtype=jnp.float32)
+    ours, _ = forward(params, jnp.asarray(ids, jnp.int32), cfg)
+    with torch.no_grad():
+        theirs = hf_model(torch.from_numpy(ids)).logits.numpy()
+    np.testing.assert_allclose(np.asarray(ours), theirs, atol=atol, rtol=1e-3)
+
+
+def test_llama_logits_match_hf(tmp_path):
+    hf = _save_hf_llama(tmp_path)
+    _assert_logits_match(tmp_path, hf, _ids())
+
+
+def test_llama_biased_logits_match_hf(tmp_path):
+    """attention_bias + mlp_bias checkpoints (the round-1 silent-wrongness
+    class): HF applies the bias tensors, and now so do we."""
+    hf = _save_hf_llama(tmp_path, attention_bias=True, mlp_bias=True)
+    # random (nonzero) biases: LlamaForCausalLM inits Linear bias to zeros,
+    # so perturb them to make the check meaningful
+    torch.manual_seed(1)
+    with torch.no_grad():
+        for name, p in hf.named_parameters():
+            if name.endswith(".bias"):
+                p.copy_(torch.randn_like(p) * 0.1)
+    hf.save_pretrained(tmp_path, safe_serialization=True)
+    _assert_logits_match(tmp_path, hf, _ids(seed=1))
+
+
+def test_gemma2_logits_match_hf(tmp_path):
+    hf = _save_hf_gemma2(tmp_path)
+    # Gemma-2 needs eager attention for the attn softcap to apply in HF
+    hf.config._attn_implementation = "eager"
+    _assert_logits_match(tmp_path, hf, _ids(seed=2), atol=5e-3)
+
+
+def test_llama_cached_decode_matches_hf_generate(tmp_path):
+    """Greedy decode through OUR cache path == HF greedy generate."""
+    hf = _save_hf_llama(tmp_path)
+    params, cfg = load_params(tmp_path, dtype=jnp.float32)
+    ids = _ids(8, seed=3)
+
+    from llm_np_cp_tpu.generate import Generator
+    from llm_np_cp_tpu.ops.sampling import Sampler
+
+    gen = Generator(params, cfg, sampler=Sampler(kind="greedy"),
+                    cache_dtype=jnp.float32)
+    ours = gen.generate(ids[0], 10).tokens[0]
+
+    with torch.no_grad():
+        theirs = hf.generate(
+            torch.from_numpy(ids), max_new_tokens=10, do_sample=False,
+            use_cache=True,
+        )[0, ids.shape[1]:].numpy()
+    np.testing.assert_array_equal(ours, theirs)
+
+
+# ----------------------------------------------------------------------
+# Full-stack CLI fixture: checkpoint + tokenizer on disk, both backends
+# ----------------------------------------------------------------------
+
+def _write_tokenizer(tmp_path):
+    from tokenizers import Tokenizer, models, pre_tokenizers, trainers
+
+    tok = Tokenizer(models.BPE(unk_token="<unk>"))
+    tok.pre_tokenizer = pre_tokenizers.Whitespace()
+    corpus = ["once upon a time there was a tiny model in a tiny test " * 4]
+    tok.train_from_iterator(
+        corpus,
+        trainers.BpeTrainer(
+            vocab_size=200, special_tokens=["<unk>", "<s>", "</s>"]
+        ),
+    )
+    fast = transformers.PreTrainedTokenizerFast(
+        tokenizer_object=tok, unk_token="<unk>", bos_token="<s>",
+        eos_token="</s>",
+    )
+    fast.save_pretrained(tmp_path)
+    return fast
+
+
+def test_cli_both_backends_on_hf_fixture(tmp_path, capsys):
+    """The reference's end-to-end surface: a local HF checkpoint dir driven
+    through the CLI on the jax backend AND the NumPy oracle backend with
+    greedy sampling must print identical text."""
+    _save_hf_llama(tmp_path)
+    _write_tokenizer(tmp_path)
+
+    from llm_np_cp_tpu.cli import run
+
+    common = [
+        "--model", str(tmp_path), "--prompt", "once upon a time",
+        "--max-tokens", "8", "--sampler", "greedy", "--dtype", "f32",
+    ]
+    jax_text = run(common + ["--backend", "tpu", "--no-stream"])
+    np_text = run(common + ["--backend", "numpy"])
+    assert jax_text == np_text
+    assert isinstance(jax_text, str)
+
+
+def test_cli_streaming_matches_fused_on_fixture(tmp_path):
+    _save_hf_llama(tmp_path)
+    _write_tokenizer(tmp_path)
+
+    from llm_np_cp_tpu.cli import run
+
+    common = [
+        "--model", str(tmp_path), "--prompt", "a tiny model",
+        "--max-tokens", "6", "--sampler", "greedy", "--dtype", "f32",
+        "--backend", "tpu",
+    ]
+    streamed = run(common)
+    fused = run(common + ["--no-stream"])
+    assert streamed == fused
