@@ -56,7 +56,6 @@ def _save_hf_llama(tmp_path, **overrides):
 def _save_hf_gemma2(tmp_path):
     cfg = transformers.Gemma2Config(
         **TINY,
-        head_dim=8,
         query_pre_attn_scalar=8.0,
         final_logit_softcapping=30.0,
         attn_logit_softcapping=50.0,
