@@ -99,6 +99,76 @@ def make_prefill_fn(
     return prefill
 
 
+def make_chunked_prefill_fn(
+    config: ModelConfig,
+    sampler: Sampler,
+    chunk_size: int,
+    attn_impl: str = "xla",
+) -> Callable:
+    """(params, prompt_ids, cache, key) → (first_token [B], cache, logits)
+    — same contract as make_prefill_fn, but the prompt is consumed in
+    fixed-width chunks of ``chunk_size`` tokens.
+
+    Each chunk is a cached q_len>1 forward at the cache's running offset
+    (the positions-based masks make this exact — the reference mis-masks
+    this path, llama3.2_model.py:471-478, so it cannot chunk).  Compile
+    cost is O(chunk_size) instead of O(prompt_len): an 8k prompt is
+    8 dispatches of ONE compiled 1k-wide program (+ at most one remainder
+    shape), not a single monolithic 8k-wide compile — the plausible cause
+    of the r2 prefill8k bench timeouts.
+
+    ``attn_impl`` ("flash"/"ring") applies to the FIRST chunk only (those
+    kernels read the freshly projected K/V and require a fresh cache —
+    models/transformer.py guards this); later chunks attend cached
+    history and use the XLA path.
+    """
+    if chunk_size < 1:
+        raise ValueError(f"chunk_size must be >= 1, got {chunk_size}")
+
+    @partial(jax.jit, donate_argnums=(2,))
+    def chunk_step(params: Params, ids: jnp.ndarray, cache: KVCache):
+        logits, cache = forward(
+            params, ids, config, cache, logits_last_only=True
+        )
+        return logits[:, -1], cache
+
+    if attn_impl == "xla":
+        first_step = chunk_step
+    else:
+
+        @partial(jax.jit, donate_argnums=(2,))
+        def first_step(params: Params, ids: jnp.ndarray, cache: KVCache):
+            logits, cache = forward(
+                params, ids, config, cache, logits_last_only=True,
+                attn_impl=attn_impl,
+            )
+            return logits[:, -1], cache
+
+    def prefill_chunked(
+        params: Params,
+        prompt_ids: jnp.ndarray,
+        cache: KVCache,
+        key: jax.Array,
+        attn_mask: jnp.ndarray | None = None,
+        pad_offsets: jnp.ndarray | None = None,
+    ):
+        if attn_mask is not None or pad_offsets is not None:
+            raise ValueError(
+                "chunked prefill does not support ragged batches "
+                "(attn_mask/pad_offsets); use the one-shot prefill"
+            )
+        s = prompt_ids.shape[1]
+        off, step, last = 0, first_step, None
+        while off < s:
+            w = min(chunk_size, s - off)
+            last, cache = step(params, prompt_ids[:, off:off + w], cache)
+            step, off = chunk_step, off + w
+        tok = sampler(key, last)
+        return tok, cache, last
+
+    return prefill_chunked
+
+
 def make_decode_step_fn(config: ModelConfig, sampler: Sampler) -> Callable:
     """(params, tok [B], cache, key) → (next_tok [B], cache) — one token.
     The cache is donated (updated in place); callers rebind it."""
@@ -179,13 +249,19 @@ class Generator:
         stop_tokens: tuple[int, ...] = (),
         cache_dtype: jnp.dtype = jnp.bfloat16,
         prefill_attn_impl: str = "xla",
+        prefill_chunk: int | None = None,
     ) -> None:
         self.params = params
         self.config = config
         self.sampler = sampler or Sampler()
         self.stop_tokens = tuple(stop_tokens)
         self.cache_dtype = cache_dtype
-        self._prefill = make_prefill_fn(config, self.sampler, prefill_attn_impl)
+        if prefill_chunk:
+            self._prefill = make_chunked_prefill_fn(
+                config, self.sampler, prefill_chunk, prefill_attn_impl
+            )
+        else:
+            self._prefill = make_prefill_fn(config, self.sampler, prefill_attn_impl)
         self.last_stream_stats: dict[str, Any] = {}
         self._step = make_decode_step_fn(config, self.sampler)
         self._loop = make_decode_loop_fn(config, self.sampler, self.stop_tokens)
