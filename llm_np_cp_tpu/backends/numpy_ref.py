@@ -158,12 +158,19 @@ def forward_np(
     nh, nk, d = config.num_attention_heads, config.num_key_value_heads, config.head_dim
     g = nh // nk
 
+    def _proj(h, w, name):
+        # Qwen-2-style checkpoints carry projection biases; dropping them
+        # silently prints wrong text (ADVICE r1 / VERDICT r2 weak #6)
+        y = h @ w[f"{name}_proj"]
+        bias = w.get(f"{name}_bias")
+        return y + bias if bias is not None else y
+
     for li in range(config.num_hidden_layers):
         w = _layer(params, li)
         h = _rms_norm(x, w["ln_attn_in"], config.rms_norm_eps, config.rms_norm_unit_offset)
-        q = (h @ w["q_proj"]).reshape(b, s, nh, d)
-        k = (h @ w["k_proj"]).reshape(b, s, nk, d)
-        v = (h @ w["v_proj"]).reshape(b, s, nk, d)
+        q = _proj(h, w, "q").reshape(b, s, nh, d)
+        k = _proj(h, w, "k").reshape(b, s, nk, d)
+        v = _proj(h, w, "v").reshape(b, s, nk, d)
         q = q * cos_h + _rotate_half(q) * sin_h
         k = k * cos_h + _rotate_half(k) * sin_h
 
@@ -185,13 +192,13 @@ def forward_np(
         scores = np.where(mask[:, None, None, :, :], scores, np.float32(-np.inf))
         probs = _softmax(scores)
         attn = np.einsum("bkgqs,bskd->bqkgd", probs, v_all).reshape(b, s, nh * d)
-        attn = attn @ w["o_proj"]
+        attn = _proj(attn, w, "o")
         if config.sandwich_norms:
             attn = _rms_norm(attn, w["ln_attn_out"], config.rms_norm_eps, config.rms_norm_unit_offset)
         x = x + attn
 
         h = _rms_norm(x, w["ln_mlp_in"], config.rms_norm_eps, config.rms_norm_unit_offset)
-        mlp = (act(h @ w["gate_proj"]) * (h @ w["up_proj"])) @ w["down_proj"]
+        mlp = _proj(act(_proj(h, w, "gate")) * _proj(h, w, "up"), w, "down")
         if config.sandwich_norms:
             mlp = _rms_norm(mlp, w["ln_mlp_out"], config.rms_norm_eps, config.rms_norm_unit_offset)
         x = x + mlp

