@@ -92,6 +92,20 @@ def _local_ring_attention(
     return jnp.moveaxis(out, 3, 1).reshape(b, s_loc, h, d)
 
 
+def _pad_seq(q, k, v, num_shards):
+    """Pad the sequence axis up to a shardable multiple (static shapes —
+    S is a trace-time constant).  Trailing pad slots sit at the HIGHEST
+    global positions, so causal masking makes them invisible to every
+    real query; callers slice the garbage pad-query rows back off.
+    Without this, any prompt whose length doesn't divide the seq axis
+    (i.e. nearly every real tokenized prompt) would be unservable."""
+    pad = -q.shape[1] % num_shards
+    if pad:
+        widths = ((0, 0), (0, pad), (0, 0), (0, 0))
+        q, k, v = jnp.pad(q, widths), jnp.pad(k, widths), jnp.pad(v, widths)
+    return q, k, v, pad
+
+
 @functools.partial(
     jax.jit,
     static_argnames=("mesh", "axis_name", "scale", "logit_softcap", "window"),
@@ -109,15 +123,14 @@ def ring_attention(
 ) -> jnp.ndarray:
     """Causal self-attention with the sequence axis sharded over ``axis_name``.
 
-    q [B, S, H, D], k/v [B, S, K, D] (global shapes; S divisible by the
-    axis size) → [B, S, H, D].  Semantically identical to the single-chip
-    path — verified against gqa_attention in tests on a virtual mesh.
+    q [B, S, H, D], k/v [B, S, K, D] (global shapes; any S — padded up to
+    the axis size internally) → [B, S, H, D].  Semantically identical to
+    the single-chip path — verified against gqa_attention in tests on a
+    virtual mesh.
     """
     num_shards = mesh.shape[axis_name]
-    if q.shape[1] % num_shards:
-        raise ValueError(
-            f"seq {q.shape[1]} not divisible by {axis_name}={num_shards}"
-        )
+    s = q.shape[1]
+    q, k, v, pad = _pad_seq(q, k, v, num_shards)
     fn = jax.shard_map(
         functools.partial(
             _local_ring_attention,
@@ -135,7 +148,8 @@ def ring_attention(
         ),
         out_specs=P(None, axis_name, None, None),
     )
-    return fn(q, k, v)
+    out = fn(q, k, v)
+    return out[:, :s] if pad else out
 
 
 def ring_attention_ctx(
@@ -167,10 +181,8 @@ def ring_attention_ctx(
             f"'{SEQ_AXIS}' axis of size >= 2; got mesh shape {dict(mesh.shape)}"
         )
     num_shards = mesh.shape[SEQ_AXIS]
-    if q.shape[1] % num_shards:
-        raise ValueError(
-            f"seq {q.shape[1]} not divisible by {SEQ_AXIS}={num_shards}"
-        )
+    s = q.shape[1]
+    q, k, v, pad = _pad_seq(q, k, v, num_shards)
     d = DATA_AXIS if mesh.shape.get(DATA_AXIS, 1) > 1 else None
     tp = mesh.shape.get(MODEL_AXIS, 1)
     m = (
@@ -194,4 +206,5 @@ def ring_attention_ctx(
         ),
         out_specs=P(d, SEQ_AXIS, m, None),
     )
-    return fn(q, k, v)
+    out = fn(q, k, v)
+    return out[:, :s] if pad else out

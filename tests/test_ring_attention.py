@@ -42,12 +42,17 @@ def test_ring_window_and_softcap(rng_np):
     np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5)
 
 
-def test_ring_rejects_indivisible_seq(rng_np):
+def test_ring_indivisible_seq_pads_and_matches(rng_np):
+    """S=30 on a 4-way seq axis: padded internally, exact result."""
     mesh = make_mesh(MeshPlan(seq=4))
-    x = jnp.zeros((1, 30, 2, 8), dtype=jnp.float32)
-    kv = jnp.zeros((1, 30, 1, 8), dtype=jnp.float32)
-    with pytest.raises(ValueError, match="not divisible"):
-        ring_attention(x, kv, kv, mesh=mesh, scale=1.0)
+    b, s, h, kh, d = 1, 30, 2, 1, 8
+    q = jnp.asarray(rng_np.standard_normal((b, s, h, d), dtype=np.float32))
+    k = jnp.asarray(rng_np.standard_normal((b, s, kh, d), dtype=np.float32))
+    v = jnp.asarray(rng_np.standard_normal((b, s, kh, d), dtype=np.float32))
+    want = _reference(q, k, v, scale=d**-0.5)
+    got = ring_attention(q, k, v, mesh=mesh, scale=d**-0.5)
+    assert got.shape == q.shape
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5)
 
 
 # ----------------------------------------------------------------------
@@ -77,6 +82,30 @@ def test_forward_ring_tp_sp_parity():
     want, _ = forward(params, ids, cfg)
 
     plan = MeshPlan(data=2, seq=2, model=2)
+    mesh = make_mesh(plan)
+    p_sh = shard_params(params, cfg, plan, mesh)
+    with jax.set_mesh(mesh):
+        got, _ = jax.jit(lambda p, i: forward(p, i, cfg, attn_impl="ring"))(p_sh, ids)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-4, rtol=1e-4)
+
+
+@pytest.mark.parametrize("seq_len", [5, 13, 15])
+def test_forward_ring_indivisible_seq_parity(seq_len):
+    """The ambient-mesh entry pads S up to the seq axis and slices back —
+    real tokenized prompts are almost never divisible by the mesh degree
+    (found driving the CLI: a 6-token prompt on seq=4 was unservable)."""
+    from llm_np_cp_tpu.models.transformer import forward, init_params
+    from llm_np_cp_tpu.parallel.sharding import shard_params
+
+    cfg = _tiny_cfg()
+    params = init_params(jax.random.PRNGKey(0), cfg, dtype=jnp.float32)
+    ids = jnp.asarray(
+        np.random.default_rng(seq_len).integers(0, cfg.vocab_size, (2, seq_len)),
+        jnp.int32,
+    )
+    want, _ = forward(params, ids, cfg)
+
+    plan = MeshPlan(seq=4, model=2)
     mesh = make_mesh(plan)
     p_sh = shard_params(params, cfg, plan, mesh)
     with jax.set_mesh(mesh):
