@@ -51,6 +51,7 @@ def min_p(key: jax.Array, logits: jnp.ndarray, p_base: float = 0.1) -> jnp.ndarr
 
 
 def top_k_mask(logits: jnp.ndarray, k: int) -> jnp.ndarray:
+    k = min(max(k, 1), logits.shape[-1])  # HF-style clamp: k=0 / k>V are user input
     kth = jnp.sort(logits, axis=-1)[..., -k][..., None]
     return jnp.where(logits >= kth, logits, NEG_INF)
 
@@ -61,8 +62,10 @@ def top_p_mask(logits: jnp.ndarray, p: float) -> jnp.ndarray:
     sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
     probs = jax.nn.softmax(sorted_logits, axis=-1)
     cum = jnp.cumsum(probs, axis=-1)
-    # token i is kept if the cumulative mass *before* it is < p
-    keep_sorted = (cum - probs) < p
+    # token i is kept if the cumulative mass *before* it is < p; the top
+    # token is forced alive so p<=0 (user input) degrades to greedy
+    # instead of masking everything
+    keep_sorted = ((cum - probs) < p).at[..., 0].set(True)
     threshold = jnp.min(
         jnp.where(keep_sorted, sorted_logits, jnp.inf), axis=-1, keepdims=True
     )
