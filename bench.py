@@ -11,7 +11,20 @@ round's perf evidence):
   unreachable the script still prints the final summary JSON — with an
   ``"error"`` field — and exits 0.
 - Each config's result line is printed to stderr AS IT COMPLETES, and the
-  final one-line summary on stdout is assembled from whatever finished.
+  full summary JSON line is RE-EMITTED on stdout after every config (last
+  line wins) — an outer kill at any moment leaves a parseable artifact
+  with everything that finished (round-2 postmortem: the single
+  end-of-run summary never printed because the driver's budget expired
+  first).
+- Configs run in priority order (headline first) against a global
+  deadline from ``BENCH_DEADLINE_S`` (default 1500 s — inside the
+  driver's observed ~30 min budget); per-config timeouts are clipped to
+  the remaining deadline and configs that can't fit are skipped, not
+  silently truncated.
+- Children print ``bench-phase`` breadcrumbs (params built, prefill
+  compiled, decode compiled, each rep) to stderr; on a timeout the
+  parent recovers the partial stderr from TimeoutExpired, so a burned
+  config still says WHERE it died (compile vs execute).
 - Subprocesses share a persistent XLA compilation cache dir so repeated
   compiles are amortized.
 
@@ -64,12 +77,12 @@ REPO = os.path.dirname(os.path.abspath(__file__))
 DECODE_CONFIGS = {
     "llama1b_bs1": dict(model="llama1b", batch=1, prompt_len=128, decode_tokens=256),
     "llama1b_bs8": dict(model="llama1b", batch=8, prompt_len=128, decode_tokens=256),
-    "llama1b_bs32": dict(model="llama1b", batch=32, prompt_len=128, decode_tokens=256),
+    "llama1b_bs32": dict(model="llama1b", batch=32, prompt_len=128, decode_tokens=128),
     "int8_bs1": dict(model="llama1b", batch=1, prompt_len=128, decode_tokens=256, quant=True),
     "int8_bs8": dict(model="llama1b", batch=8, prompt_len=128, decode_tokens=256, quant=True),
     "gemma2_2b_bs1": dict(model="gemma2_2b", batch=1, prompt_len=128, decode_tokens=256),
     "llama3b_seq2048_bs8": dict(
-        model="llama3b", batch=8, prompt_len=2048, decode_tokens=128, sampler="top_p"
+        model="llama3b", batch=8, prompt_len=2048, decode_tokens=64, sampler="top_p"
     ),
     # not in the default matrix: offline smoke test of the measurement path
     "smoke_tiny": dict(model="tiny", batch=2, prompt_len=16, decode_tokens=8),
@@ -77,6 +90,8 @@ DECODE_CONFIGS = {
 PREFILL_CONFIGS = {
     "prefill8k_xla": dict(model="llama1b", prompt_len=8192, attn_impl="xla"),
     "prefill8k_flash": dict(model="llama1b", prompt_len=8192, attn_impl="flash"),
+    "prefill8k_chunked": dict(model="llama1b", prompt_len=8192, attn_impl="xla",
+                              chunk=1024),
 }
 SPEC_CONFIGS = {
     # batched self-speculation: bf16 target + int8 self-draft, γ=4
@@ -86,10 +101,47 @@ SPEC_CONFIGS = {
     "smoke_spec": dict(model="tiny", batch=2, prompt_len=16, decode_tokens=8,
                        gamma=2),
 }
-TIMEOUTS = {"llama3b_seq2048_bs8": 900, "prefill8k_xla": 600, "prefill8k_flash": 600}
-DEFAULT_TIMEOUT = 600
+# Priority order (VERDICT r2 task 1b): headline first, then the BASELINE
+# configs that have never produced a number, cheap extras last.  A burned
+# config only costs its own timeout — the summary re-emits after each.
+PRIORITY = [
+    "llama1b_bs8",        # the headline
+    "gemma2_2b_bs1",      # BASELINE config 2 — never captured
+    "llama1b_bs1",        # r2's one captured number (cached compile)
+    "int8_bs8",           # VERDICT task 7
+    "int8_spec_bs8",      # VERDICT task 7
+    "prefill8k_chunked",  # BASELINE config 5 via chunked prefill
+    "prefill8k_flash",
+    "prefill8k_xla",
+    "llama1b_bs32",
+    "llama3b_seq2048_bs8",  # 3B params: the most expensive, last
+    "int8_bs1",
+]
+# every non-smoke config must be in PRIORITY — a config added to the dicts
+# but not the ordering would otherwise silently never run
+assert set(PRIORITY) == {
+    n
+    for n in list(DECODE_CONFIGS) + list(SPEC_CONFIGS) + list(PREFILL_CONFIGS)
+    if not n.startswith("smoke")
+}, "PRIORITY out of sync with config dicts"
+
+TIMEOUTS = {"llama1b_bs8": 540, "llama3b_seq2048_bs8": 480}
+DEFAULT_TIMEOUT = 360
 PROBE_TIMEOUT = 180
-GLOBAL_DEADLINE_S = 3600  # stop launching new configs past this
+MIN_CONFIG_BUDGET_S = 120  # don't launch a config with less than this left
+
+
+def _deadline_s() -> float:
+    return float(os.environ.get("BENCH_DEADLINE_S", "1500"))
+
+
+def _phase(config: str, phase: str, t0: float, **extra) -> None:
+    """Timestamped breadcrumb on stderr.  These survive a parent-side
+    timeout kill (recovered from TimeoutExpired.stderr), so a burned
+    config still records whether it died in compile or execute."""
+    rec = {"config": config, "phase": phase, "t": round(time.perf_counter() - t0, 1)}
+    rec.update(extra)
+    print("bench-phase " + json.dumps(rec), file=sys.stderr, flush=True)
 
 
 # ----------------------------------------------------------------------
@@ -138,28 +190,41 @@ def _tree_bytes(tree) -> int:
     return sum(x.size * x.dtype.itemsize for x in jax.tree.leaves(tree))
 
 
-def _chained_reps(one, seed_prompt, vocab_size, reps=3):
-    """Run ``one(prompt_host)`` reps+1 times (first is compile warmup) with
-    FRESH inputs each rep, chained through the previous output — the
+def _chained_reps(one, seed_prompt, vocab_size, reps=3, on_warm=None):
+    """Run ``one(prompt_host, tag)`` reps+1 times (first is compile warmup)
+    with FRESH inputs each rep, chained through the previous output — the
     tunneled transport dedupes repeated executions with identical live
     inputs, so a repeated (executable, args) pair measures nothing.
 
     ``one`` returns a result dict that includes ``"chain"``: an int derived
     from a materialized (host) output, proving the execution completed and
-    perturbing the next prompt.  Returns the ``reps`` measured dicts.
+    perturbing the next prompt; ``tag`` ("warmup"/"repN") lets it emit
+    bench-phase breadcrumbs.  Returns the ``reps`` measured dicts.
+    ``on_warm`` (if given) is called with the warmup wall-clock — the
+    compile-phase cost, reported separately from the measured reps.
     """
     carry = seed_prompt
-    out = one(carry)  # warmup: compile
+    t0 = time.perf_counter()
+    out = one(carry, "warmup")  # compile
+    if on_warm is not None:
+        on_warm(time.perf_counter() - t0)
     results = []
     for i in range(reps):
         carry = (carry + out["chain"] + i + 1) % vocab_size
-        out = one(carry)
+        out = one(carry, f"rep{i}")
         results.append(out)
     return results
 
 
-def _measure_decode(config, params, prefill, loop, batch, prompt_len, decode_tokens, reps=3):
-    """Median TTFT + aggregate decode rate over ``reps`` fresh-input runs."""
+def _measure_decode(name, config, params, prefill, loop, batch, prompt_len,
+                    decode_tokens, reps=3, t_start=None):
+    """Median TTFT + aggregate decode rate over ``reps`` fresh-input runs.
+
+    Warmup is split into two timed phases (prefill compile, decode-loop
+    compile) with ``bench-phase`` breadcrumbs, so a timeout kill records
+    which compile burned the budget (VERDICT r2 weak #2: the bs=8 600 s
+    timeout was undiagnosable from artifacts).
+    """
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -169,29 +234,36 @@ def _measure_decode(config, params, prefill, loop, batch, prompt_len, decode_tok
     key = jax.random.PRNGKey(0)
     max_seq = prompt_len + decode_tokens + 8
     rng = np.random.default_rng(batch)
+    if t_start is None:
+        t_start = time.perf_counter()
 
-    def one(prompt_host):
+    def one(prompt_host, tag):
         cache = KVCache.init(config, batch, max_seq, dtype=jnp.bfloat16)
         t0 = time.perf_counter()
         tok0, cache, _ = prefill(params, jnp.asarray(prompt_host, jnp.int32), cache, key)
         np.asarray(tok0)  # force real D2H — block_until_ready is not a fence here
         t1 = time.perf_counter()
+        _phase(name, f"{tag}:prefill_done", t_start, dt=round(t1 - t0, 1))
         toks, cache = loop(params, tok0, cache, key, decode_tokens)
         toks_host = np.asarray(toks)
         t2 = time.perf_counter()
+        _phase(name, f"{tag}:decode_done", t_start, dt=round(t2 - t1, 1))
         return {
             "ttft": t1 - t0,
             "rate": batch * decode_tokens / (t2 - t1),
             "chain": int(toks_host.sum()),
         }
 
+    compile_s = [0.0]
     runs = _chained_reps(
         one, rng.integers(0, config.vocab_size, (batch, prompt_len)),
         config.vocab_size, reps,
+        on_warm=lambda dt: compile_s.__setitem__(0, dt),
     )
     return (
         float(np.median([r["ttft"] for r in runs])),
         float(np.median([r["rate"] for r in runs])),
+        compile_s[0],
     )
 
 
@@ -201,14 +273,19 @@ def run_decode_config(name: str) -> dict:
     from llm_np_cp_tpu.generate import make_decode_loop_fn, make_prefill_fn
     from llm_np_cp_tpu.ops.sampling import Sampler
 
+    t0 = time.perf_counter()
     spec = DECODE_CONFIGS[name]
     config, params = _build_model(spec["model"], quant=spec.get("quant", False))
+    _phase(name, "params_built", t0)
     sampler = Sampler(kind=spec.get("sampler", "greedy"))
     prefill = make_prefill_fn(config, sampler)
     loop = make_decode_loop_fn(config, sampler)
     batch, prompt_len, decode_tokens = spec["batch"], spec["prompt_len"], spec["decode_tokens"]
 
-    ttft, rate = _measure_decode(config, params, prefill, loop, batch, prompt_len, decode_tokens)
+    ttft, rate, compile_s = _measure_decode(
+        name, config, params, prefill, loop, batch, prompt_len, decode_tokens,
+        t_start=t0,
+    )
 
     # Roofline accounting: each decode step streams the full weight set plus
     # the valid KV prefix for every sequence (mean length over the run).
@@ -227,6 +304,7 @@ def run_decode_config(name: str) -> dict:
         "hbm_gb_s": round(hbm_gb_s, 1),
         "hbm_roofline_frac": round(hbm_gb_s / HBM_GB_S, 3),
         "param_gb": round(param_bytes / 1e9, 2),
+        "compile_s": round(compile_s, 1),
         "batch": batch,
         "prompt_len": prompt_len,
         "decode_tokens": decode_tokens,
@@ -239,26 +317,40 @@ def run_prefill_config(name: str) -> dict:
     import numpy as np
 
     from llm_np_cp_tpu.cache import KVCache
-    from llm_np_cp_tpu.generate import make_prefill_fn
+    from llm_np_cp_tpu.generate import make_chunked_prefill_fn, make_prefill_fn
     from llm_np_cp_tpu.ops.sampling import Sampler
 
+    t_start = time.perf_counter()
     spec = PREFILL_CONFIGS[name]
     config, params = _build_model(spec["model"])
+    _phase(name, "params_built", t_start)
     prompt_len = spec["prompt_len"]
-    prefill = make_prefill_fn(config, Sampler(kind="greedy"), attn_impl=spec["attn_impl"])
+    chunk = spec.get("chunk")
+    if chunk:
+        prefill = make_chunked_prefill_fn(
+            config, Sampler(kind="greedy"), chunk_size=chunk,
+            attn_impl=spec["attn_impl"],
+        )
+    else:
+        prefill = make_prefill_fn(
+            config, Sampler(kind="greedy"), attn_impl=spec["attn_impl"]
+        )
     key = jax.random.PRNGKey(0)
     rng = np.random.default_rng(0)
 
-    def one(prompt_host):
+    def one(prompt_host, tag):
         cache = KVCache.init(config, 1, prompt_len + 8, dtype=jnp.bfloat16)
         t0 = time.perf_counter()
         tok0, _, _ = prefill(params, jnp.asarray(prompt_host, jnp.int32), cache, key)
         out = np.asarray(tok0)
-        return {"ttft": time.perf_counter() - t0, "chain": int(out.sum())}
+        dt = time.perf_counter() - t0
+        _phase(name, f"{tag}:prefill_done", t_start, dt=round(dt, 1))
+        return {"ttft": dt, "chain": int(out.sum())}
 
+    compile_s = [0.0]
     runs = _chained_reps(
         one, rng.integers(0, config.vocab_size, (1, prompt_len)),
-        config.vocab_size,
+        config.vocab_size, on_warm=lambda dt: compile_s.__setitem__(0, dt),
     )
     ttft = float(np.median([r["ttft"] for r in runs]))
     return {
@@ -268,6 +360,8 @@ def run_prefill_config(name: str) -> dict:
         "prefill_tok_s": round(prompt_len / ttft, 1),
         "prompt_len": prompt_len,
         "attn_impl": spec["attn_impl"],
+        **({"chunk": chunk} if chunk else {}),
+        "compile_s": round(compile_s[0], 1),
     }
 
 
@@ -277,16 +371,19 @@ def run_spec_config(name: str) -> dict:
     from llm_np_cp_tpu.ops.sampling import Sampler
     from llm_np_cp_tpu.speculative import SpeculativeGenerator
 
+    t_start = time.perf_counter()
     spec = SPEC_CONFIGS[name]
     config, params = _build_model(spec["model"])
+    _phase(name, "params_built", t_start)
     gen = SpeculativeGenerator(
         params, config, gamma=spec["gamma"], sampler=Sampler(kind="greedy")
     )
     batch, prompt_len, decode_tokens = spec["batch"], spec["prompt_len"], spec["decode_tokens"]
     rng = np.random.default_rng(0)
 
-    def one(prompt_host):
+    def one(prompt_host, tag):
         res = gen.generate(prompt_host, decode_tokens)
+        _phase(name, f"{tag}:done", t_start)
         return {
             "rate": res.decode_tokens_per_s,
             "acc": res.acceptance_rate,
@@ -348,16 +445,27 @@ def child_main(mode: str) -> None:
 # Parent-process orchestration
 # ----------------------------------------------------------------------
 
-def _spawn(mode: str, timeout: int) -> dict:
+def _spawn(mode: str, timeout: float) -> dict:
     """Run `python bench.py --run mode` with a hard timeout; parse the last
-    JSON line of its stdout.  Never raises."""
+    JSON line of its stdout.  Never raises.  On timeout, the child's
+    partial stderr (recovered from TimeoutExpired) yields the last
+    ``bench-phase`` breadcrumbs — where the budget actually went."""
     cmd = [sys.executable, os.path.abspath(__file__), "--run", mode]
     try:
         proc = subprocess.run(
             cmd, capture_output=True, text=True, timeout=timeout, cwd=REPO
         )
-    except subprocess.TimeoutExpired:
-        return {"config": mode, "ok": False, "error": f"timeout after {timeout}s"}
+    except subprocess.TimeoutExpired as e:
+        err = e.stderr or b""
+        if isinstance(err, bytes):
+            err = err.decode(errors="replace")
+        phases = [l for l in err.splitlines() if l.startswith("bench-phase")]
+        return {
+            "config": mode,
+            "ok": False,
+            "error": f"timeout after {round(timeout)}s",
+            "last_phases": phases[-4:],
+        }
     for line in reversed(proc.stdout.strip().splitlines()):
         try:
             return json.loads(line)
@@ -417,6 +525,7 @@ def main() -> None:
         return
 
     t_start = time.time()
+    deadline = _deadline_s()
     # Probe with one retry: the tunnel has been observed to hang on first use.
     probe = _spawn("probe", PROBE_TIMEOUT)
     if not probe.get("ok"):
@@ -426,20 +535,30 @@ def main() -> None:
         _emit_summary({}, probe, error=f"TPU backend unreachable: {probe.get('error')}")
         return
 
-    names = args.configs or [
-        n
-        for n in list(DECODE_CONFIGS) + list(SPEC_CONFIGS) + list(PREFILL_CONFIGS)
-        if not n.startswith("smoke")
-    ]
+    names = args.configs or list(PRIORITY)
     detail: dict[str, dict] = {}
     for name in names:
-        if time.time() - t_start > GLOBAL_DEADLINE_S:
-            detail[name] = {"config": name, "ok": False, "error": "global deadline"}
+        remaining = deadline - (time.time() - t_start)
+        if remaining < MIN_CONFIG_BUDGET_S:
+            detail[name] = {
+                "config": name, "ok": False,
+                "error": f"skipped: {round(remaining)}s left of "
+                         f"BENCH_DEADLINE_S={round(deadline)}",
+            }
+            print(json.dumps(detail[name]), file=sys.stderr, flush=True)
             continue
-        res = _spawn(name, TIMEOUTS.get(name, DEFAULT_TIMEOUT))
+        budget = min(TIMEOUTS.get(name, DEFAULT_TIMEOUT), remaining - 10)
+        res = _spawn(name, budget)
         detail[name] = res
         print(json.dumps(res), file=sys.stderr, flush=True)
+        # Re-emit the FULL summary after every config (last stdout line
+        # wins) so an outer kill at any moment leaves a parseable artifact.
+        failed = [n for n, r in detail.items() if not r.get("ok")]
+        _emit_summary(
+            detail, probe, error=f"configs failed: {failed}" if failed else None
+        )
 
+    # Final emit covers the nothing-ran / everything-skipped path too.
     failed = [n for n, r in detail.items() if not r.get("ok")]
     _emit_summary(
         detail, probe, error=f"configs failed: {failed}" if failed else None
