@@ -81,6 +81,9 @@ DECODE_CONFIGS = {
     "int8_bs1": dict(model="llama1b", batch=1, prompt_len=128, decode_tokens=256, quant=True),
     "int8_bs8": dict(model="llama1b", batch=8, prompt_len=128, decode_tokens=256, quant=True),
     "gemma2_2b_bs1": dict(model="gemma2_2b", batch=1, prompt_len=128, decode_tokens=256),
+    # the fused Pallas decode-attention experiment (keep only if it wins)
+    "llama1b_bs8_fdec": dict(model="llama1b", batch=8, prompt_len=128,
+                             decode_tokens=256, decode_attn="flash_decode"),
     "llama3b_seq2048_bs8": dict(
         model="llama3b", batch=8, prompt_len=2048, decode_tokens=64, sampler="top_p"
     ),
@@ -114,6 +117,7 @@ PRIORITY = [
     "prefill8k_flash",
     "prefill8k_xla",
     "llama1b_bs32",
+    "llama1b_bs8_fdec",   # Pallas decode-attention experiment vs bs8
     "llama3b_seq2048_bs8",  # 3B params: the most expensive, last
     "int8_bs1",
 ]
@@ -279,7 +283,9 @@ def run_decode_config(name: str) -> dict:
     _phase(name, "params_built", t0)
     sampler = Sampler(kind=spec.get("sampler", "greedy"))
     prefill = make_prefill_fn(config, sampler)
-    loop = make_decode_loop_fn(config, sampler)
+    loop = make_decode_loop_fn(
+        config, sampler, attn_impl=spec.get("decode_attn", "xla")
+    )
     batch, prompt_len, decode_tokens = spec["batch"], spec["prompt_len"], spec["decode_tokens"]
 
     ttft, rate, compile_s = _measure_decode(

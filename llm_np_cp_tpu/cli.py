@@ -63,6 +63,9 @@ def build_parser(default_model: str) -> argparse.ArgumentParser:
                    help="prefill the prompt in N-token chunks (bounds compile "
                         "cost for long prompts; one compiled program reused "
                         "per chunk)")
+    p.add_argument("--decode-attn", choices=["xla", "pallas"], default="xla",
+                   help="decode-step attention: xla (default) or the fused "
+                        "Pallas kernel over the cache slab")
     p.add_argument("--speculative", type=int, default=0, metavar="GAMMA",
                    help="speculative decoding: GAMMA draft proposals per "
                         "round from an int8 self-draft (exact target "
@@ -189,10 +192,12 @@ def _run_tpu(args) -> str:
 
     if args.speculative > 0 and (
         args.attn_impl or args.flash_prefill or args.prefill_chunk
+        or args.decode_attn != "xla"
     ):
         raise SystemExit(
-            "--speculative uses its own fused prefill/verify pipeline; "
-            "--attn-impl/--flash-prefill/--prefill-chunk do not apply to it"
+            "--speculative uses its own fused draft/verify pipeline; "
+            "--attn-impl/--flash-prefill/--prefill-chunk/--decode-attn "
+            "do not apply to it"
         )
     attn_impl = args.attn_impl or ("flash" if args.flash_prefill else "xla")
     if attn_impl == "ring" and (mesh is None or seq <= 1):
@@ -248,6 +253,7 @@ def _run_tpu(args) -> str:
         cache_dtype=cache_dtype,
         prefill_attn_impl=attn_impl,
         prefill_chunk=args.prefill_chunk,
+        decode_attn_impl="flash_decode" if args.decode_attn == "pallas" else "xla",
     )
 
     with ctx:

@@ -169,14 +169,17 @@ def make_chunked_prefill_fn(
     return prefill_chunked
 
 
-def make_decode_step_fn(config: ModelConfig, sampler: Sampler) -> Callable:
+def make_decode_step_fn(
+    config: ModelConfig, sampler: Sampler, attn_impl: str = "xla"
+) -> Callable:
     """(params, tok [B], cache, key) → (next_tok [B], cache) — one token.
     The cache is donated (updated in place); callers rebind it."""
 
     @partial(jax.jit, donate_argnums=(2,))
     def step(params: Params, tok: jnp.ndarray, cache: KVCache, key: jax.Array):
         logits, cache = forward(
-            params, tok[:, None], config, cache, logits_last_only=True
+            params, tok[:, None], config, cache, logits_last_only=True,
+            attn_impl=attn_impl,
         )
         return sampler(key, logits[:, -1]), cache
 
@@ -184,7 +187,10 @@ def make_decode_step_fn(config: ModelConfig, sampler: Sampler) -> Callable:
 
 
 def make_decode_loop_fn(
-    config: ModelConfig, sampler: Sampler, stop_tokens: tuple[int, ...] = ()
+    config: ModelConfig,
+    sampler: Sampler,
+    stop_tokens: tuple[int, ...] = (),
+    attn_impl: str = "xla",
 ) -> Callable:
     """(params, first_tok, cache, key, num_steps) → (tokens [B, steps], cache).
 
@@ -192,6 +198,8 @@ def make_decode_loop_fn(
     ``num_steps`` is static (one compile per distinct value).  Sequences
     that hit a stop token keep feeding it (outputs past EOS are repeats the
     caller trims) — branchless, so the scan stays a single fused program.
+    attn_impl="flash_decode" routes each step's attention through the
+    fused Pallas decode kernel (benchmark-gated; default XLA).
     """
     stops = jnp.asarray(stop_tokens, dtype=jnp.int32) if stop_tokens else None
 
@@ -210,7 +218,7 @@ def make_decode_loop_fn(
             tok, cache, done = carry
             logits, cache = forward(
                 params, tok[:, None], config, cache, logits_last_only=True,
-                pad_offsets=pad_offsets,
+                pad_offsets=pad_offsets, attn_impl=attn_impl,
             )
             nxt = sampler(k, logits[:, -1])
             if stops is not None:
@@ -250,12 +258,21 @@ class Generator:
         cache_dtype: jnp.dtype = jnp.bfloat16,
         prefill_attn_impl: str = "xla",
         prefill_chunk: int | None = None,
+        decode_attn_impl: str = "xla",
     ) -> None:
         self.params = params
         self.config = config
         self.sampler = sampler or Sampler()
         self.stop_tokens = tuple(stop_tokens)
         self.cache_dtype = cache_dtype
+        if decode_attn_impl not in ("xla", "flash_decode"):
+            # the CLI's user-facing name is "pallas"; catch it (and typos)
+            # here instead of silently falling back to the XLA path in
+            # run_decoder_layer
+            raise ValueError(
+                f"decode_attn_impl must be 'xla' or 'flash_decode', "
+                f"got {decode_attn_impl!r}"
+            )
         if prefill_chunk:
             self._prefill = make_chunked_prefill_fn(
                 config, self.sampler, prefill_chunk, prefill_attn_impl
@@ -263,8 +280,10 @@ class Generator:
         else:
             self._prefill = make_prefill_fn(config, self.sampler, prefill_attn_impl)
         self.last_stream_stats: dict[str, Any] = {}
-        self._step = make_decode_step_fn(config, self.sampler)
-        self._loop = make_decode_loop_fn(config, self.sampler, self.stop_tokens)
+        self._step = make_decode_step_fn(config, self.sampler, decode_attn_impl)
+        self._loop = make_decode_loop_fn(
+            config, self.sampler, self.stop_tokens, decode_attn_impl
+        )
 
     def _init_cache(self, batch: int, max_seq_len: int) -> KVCache:
         return KVCache.init(self.config, batch, max_seq_len, dtype=self.cache_dtype)

@@ -262,6 +262,18 @@ def run_decoder_layer(
             )
         else:
             attn = _fresh_attn(None)
+    elif attn_impl == "flash_decode" and s == 1:
+        # Fused single-token attention over the cache slab; consumes the
+        # same mask as the XLA path (validity ∧ window ∧ ragged pads), so
+        # every decode feature works unchanged.  Prefill/chunked calls
+        # (s > 1) under this impl fall through to the XLA path below.
+        from llm_np_cp_tpu.ops.pallas.decode_attention import decode_attention
+
+        attn = decode_attention(
+            q, k_att, v_att, jnp.broadcast_to(mask, (b, 1, k_att.shape[1]))[:, 0],
+            scale=config.attn_scale,
+            logit_softcap=config.attn_logit_softcapping,
+        )
     else:
         attn = gqa_attention(
             q, k_att, v_att, mask,
@@ -352,11 +364,14 @@ def forward(
         "ring" (sequence-parallel ring attention over the ambient mesh's
         "seq" axis — parallel/ring_attention.py; replaces the reference's
         single-device full [S,S] score matrix, llama3.2_model.py:467-469).
-        Both non-default impls are valid only for self-attention over
-        positions 0..S-1 (fresh-cache prefill or cache-less forward with
-        no padding); the cache is still written, but attention reads the
-        current K/V directly (identical by causality since later slots
-        are masked).
+        Both are valid only for self-attention over positions 0..S-1
+        (fresh-cache prefill or cache-less forward with no padding); the
+        cache is still written, but attention reads the current K/V
+        directly (identical by causality since later slots are masked).
+        "flash_decode" fuses the single-token decode step over the cache
+        slab (ops/pallas/decode_attention.py); it consumes the standard
+        mask, so it composes with caches, ragged batches, and sliding
+        windows, and falls back to XLA for q_len > 1.
 
     Returns (logits, new_cache) — logits [B, S, V] float32 (or [B, 1, V]
     when logits_last_only) — plus an aux dict with "hidden_states" /

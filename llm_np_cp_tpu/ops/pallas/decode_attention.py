@@ -1,0 +1,152 @@
+"""Fused decode-step attention over the static KV cache.
+
+The decode step attends ONE query token per sequence against the whole
+cache slab ([B, S_max, K, D]).  The XLA path computes scores → softmax →
+weighted sum as separate HLOs; this kernel streams each KV block through
+VMEM once with online-softmax state, the decode analogue of the prefill
+flash kernel (ops/pallas/softmax.py lineage; the reference's custom CUDA
+kernel role, SURVEY §2.3).
+
+Design choices vs the prefill kernel:
+- mask-driven, not position-driven: the caller passes the SAME [B, S_max]
+  boolean mask the XLA path uses (cache validity ∧ causality ∧ sliding
+  window ∧ ragged-batch pads), so every decode feature — including
+  per-row lengths from batched speculative decoding — works unchanged.
+- the grouped query heads for one KV head ride along as a tiny [G, D]
+  block; decode is HBM-bound on the K/V stream, so MXU shape efficiency
+  is irrelevant — the win, if any, is fusion (no [B,H,S] score
+  materialization between HLOs).
+
+Benchmark-gated like every kernel here (SURVEY §7 step 7): wired as
+``attn_impl="flash_decode"``, default stays XLA.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = float(jnp.finfo(jnp.float32).min)
+
+
+def _decode_kernel(
+    q_ref, k_ref, v_ref, mask_ref, o_ref, m_ref, l_ref, acc_ref,
+    *, scale: float, softcap: float | None,
+):
+    j = pl.program_id(1)  # kv block
+    nj = pl.num_programs(1)
+
+    @pl.when(j == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    q = q_ref[0].astype(jnp.float32)  # [G, D]
+    k = k_ref[0].astype(jnp.float32)  # [block_s, D]
+    v = v_ref[0].astype(jnp.float32)
+
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    ) * scale  # [G, block_s]
+    if softcap is not None:
+        s = jnp.tanh(s / softcap) * softcap
+    s = jnp.where(mask_ref[0][None, :], s, NEG_INF)
+
+    m_prev = m_ref[:]  # [G, 1]
+    m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+    p = jnp.exp(s - m_new)
+    # re-zero masked slots: exp(NEG_INF - m) underflows to 0 for any real
+    # m, but a FULLY-masked row has m == NEG_INF and would get p == 1
+    # everywhere, silently averaging V over garbage slots
+    p = jnp.where(mask_ref[0][None, :], p, 0.0)
+    alpha = jnp.exp(m_prev - m_new)
+    l_ref[:] = l_ref[:] * alpha + jnp.sum(p, axis=-1, keepdims=True)
+    acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
+        p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    )
+    m_ref[:] = m_new
+
+    @pl.when(j == nj - 1)
+    def _finalize():
+        # A row with nothing visible (can't happen for real rows — the
+        # current token is always valid) has l == 0 thanks to the p
+        # re-zeroing above; emit zeros instead of dividing by zero.
+        l = jnp.where(l_ref[:] == 0.0, 1.0, l_ref[:])
+        o_ref[0] = (acc_ref[:] / l).astype(o_ref.dtype)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("scale", "logit_softcap", "block_s", "interpret"),
+)
+def decode_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    mask: jnp.ndarray,
+    *,
+    scale: float,
+    logit_softcap: float | None = None,
+    block_s: int = 512,
+    interpret: bool | None = None,
+) -> jnp.ndarray:
+    """One-token GQA attention against the cache.
+
+    q [B, 1, H, D], k/v [B, S, K, D], mask [B, S] bool (True = visible)
+    → [B, 1, H, D].  Equivalent to ``gqa_attention(q, k, v, mask[:,None,:])``
+    — verified against it in tests.
+
+    interpret=None auto-selects: compiled on TPU, interpreter elsewhere.
+    """
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    b, one, h, d = q.shape
+    assert one == 1, f"decode_attention is q_len=1 only, got {one}"
+    _, s, kh, _ = k.shape
+    g = h // kh
+    out_dtype = q.dtype
+
+    # [B, 1, H, D] → [B*K, G, D]; kv → [B*K, S, D]; mask rides per batch.
+    qf = q.reshape(b, kh, g, d).reshape(b * kh, g, d)
+    kf = k.transpose(0, 2, 1, 3).reshape(b * kh, s, d)
+    vf = v.transpose(0, 2, 1, 3).reshape(b * kh, s, d)
+
+    block_s = min(block_s, max(s, 1))
+    s_pad = (-s) % block_s
+    if s_pad:
+        kf = jnp.pad(kf, ((0, 0), (0, s_pad), (0, 0)))
+        vf = jnp.pad(vf, ((0, 0), (0, s_pad), (0, 0)))
+        mask = jnp.pad(mask, ((0, 0), (0, s_pad)))  # pads masked out
+    sp = s + s_pad
+
+    grid = (b * kh, sp // block_s)
+    out = pl.pallas_call(
+        functools.partial(_decode_kernel, scale=scale, softcap=logit_softcap),
+        out_shape=jax.ShapeDtypeStruct((b * kh, g, d), out_dtype),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, g, d), lambda bk, j: (bk, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_s, d), lambda bk, j: (bk, j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_s, d), lambda bk, j: (bk, j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_s), lambda bk, j, _kh=kh: (bk // _kh, j),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, g, d), lambda bk, j: (bk, 0, 0),
+                               memory_space=pltpu.VMEM),
+        scratch_shapes=[
+            pltpu.VMEM((g, 1), jnp.float32),
+            pltpu.VMEM((g, 1), jnp.float32),
+            pltpu.VMEM((g, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qf, kf, vf, mask)
+
+    return out.reshape(b, kh, g, d).reshape(b, 1, h, d)

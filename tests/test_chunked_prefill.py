@@ -80,6 +80,43 @@ def test_generator_chunked_decode_matches_oneshot(model):
     np.testing.assert_array_equal(a, b)
 
 
+def test_long_context_2k_chunked_matches_oneshot(model):
+    """seq=2048 end to end (SURVEY §5 long-context row): chunked == one-shot
+    logits at BASELINE config-3 prompt scale, on the tiny model."""
+    config, params = model
+    rng = np.random.default_rng(11)
+    ids = jnp.asarray(rng.integers(0, config.vocab_size, (1, 2048)), jnp.int32)
+    key = jax.random.PRNGKey(0)
+    sampler = Sampler(kind="greedy")
+
+    one = make_prefill_fn(config, sampler)
+    tok_a, _, logits_a = one(
+        params, ids, KVCache.init(config, 1, 2064, dtype=jnp.float32), key
+    )
+    chunked = make_chunked_prefill_fn(config, sampler, chunk_size=256)
+    tok_b, cache_b, logits_b = chunked(
+        params, ids, KVCache.init(config, 1, 2064, dtype=jnp.float32), key
+    )
+    np.testing.assert_allclose(
+        np.asarray(logits_a), np.asarray(logits_b), atol=1e-4, rtol=1e-4
+    )
+    np.testing.assert_array_equal(np.asarray(tok_a), np.asarray(tok_b))
+    assert int(cache_b.length) == 2048
+
+
+def test_long_context_8k_chunked_decode(model):
+    """BASELINE config-5 shape (8k prompt) runs end to end through chunked
+    prefill + fused decode without ever compiling an 8k-wide program."""
+    config, params = model
+    rng = np.random.default_rng(13)
+    prompt = rng.integers(0, config.vocab_size, (8192,))
+    gen = Generator(params, config, sampler=Sampler(kind="greedy"),
+                    cache_dtype=jnp.float32, prefill_chunk=512)
+    res = gen.generate(prompt, 4, max_seq_len=8200)
+    assert res.tokens.shape == (1, 4)
+    assert np.isfinite(res.ttft_s)
+
+
 def test_chunked_rejects_ragged(model):
     config, params = model
     chunked = make_chunked_prefill_fn(config, Sampler(kind="greedy"), 4)

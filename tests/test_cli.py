@@ -213,6 +213,15 @@ def test_cli_top_k_top_p_flags(fake_load, capsys):
     assert greedy == p_zero == p_zero_np
 
 
+def test_cli_decode_attn_pallas_matches_xla(fake_load, capsys):
+    a = cli.run(["--backend=tpu", "--sampler=greedy", "--max-tokens=5",
+                 "--dtype=f32", "--no-stream", "--decode-attn=pallas",
+                 "--prompt=hello"])
+    b = cli.run(["--backend=tpu", "--sampler=greedy", "--max-tokens=5",
+                 "--dtype=f32", "--no-stream", "--prompt=hello"])
+    assert a == b
+
+
 def test_cli_speculative_rejects_prefill_flags(fake_load):
     """--speculative has its own pipeline; prefill flags must not be
     silently dropped."""
