@@ -174,7 +174,18 @@ def _run_tpu(args) -> str:
 
     from llm_np_cp_tpu.generate import Generator
     from llm_np_cp_tpu.ops.sampling import Sampler
-    from llm_np_cp_tpu.parallel.sharding import MeshPlan, make_mesh, shard_params
+    from llm_np_cp_tpu.parallel.sharding import (
+        make_mesh, parse_mesh_spec, shard_params,
+    )
+
+    plan = parse_mesh_spec(args.mesh)
+    if plan.pipe > 1 or plan.expert > 1:
+        raise SystemExit(
+            "pipe/expert parallelism are training-side axes "
+            "(python -m llm_np_cp_tpu.train); inference meshes use "
+            "data/seq/model"
+        )
+    seq = plan.seq
 
     tok, params, config = _load(args)
 
@@ -182,8 +193,6 @@ def _run_tpu(args) -> str:
         from llm_np_cp_tpu.quant import quantize_params
 
         params = quantize_params(params)
-    data, seq, model = (int(x) for x in args.mesh.split(","))
-    plan = MeshPlan(data=data, seq=seq, model=model)
     mesh = None
     if plan.num_devices > 1:
         plan.validate(config)

@@ -37,8 +37,8 @@ def _decode_kernel(
     q_ref, k_ref, v_ref, mask_ref, o_ref, m_ref, l_ref, acc_ref,
     *, scale: float, softcap: float | None,
 ):
-    j = pl.program_id(1)  # kv block
-    nj = pl.num_programs(1)
+    j = pl.program_id(2)  # kv block (innermost: scratch accumulates per (b,kh))
+    nj = pl.num_programs(2)
 
     @pl.when(j == 0)
     def _init():
@@ -46,9 +46,9 @@ def _decode_kernel(
         l_ref[:] = jnp.zeros_like(l_ref)
         acc_ref[:] = jnp.zeros_like(acc_ref)
 
-    q = q_ref[0].astype(jnp.float32)  # [G, D]
-    k = k_ref[0].astype(jnp.float32)  # [block_s, D]
-    v = v_ref[0].astype(jnp.float32)
+    q = q_ref[0, 0].astype(jnp.float32)  # [G, D]
+    k = k_ref[0, :, 0].astype(jnp.float32)  # [block_s, D]
+    v = v_ref[0, :, 0].astype(jnp.float32)
 
     s = jax.lax.dot_general(
         q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
@@ -77,7 +77,7 @@ def _decode_kernel(
         # current token is always valid) has l == 0 thanks to the p
         # re-zeroing above; emit zeros instead of dividing by zero.
         l = jnp.where(l_ref[:] == 0.0, 1.0, l_ref[:])
-        o_ref[0] = (acc_ref[:] / l).astype(o_ref.dtype)
+        o_ref[0, 0] = (acc_ref[:] / l).astype(o_ref.dtype)
 
 
 @functools.partial(
@@ -111,35 +111,38 @@ def decode_attention(
     g = h // kh
     out_dtype = q.dtype
 
-    # [B, 1, H, D] → [B*K, G, D]; kv → [B*K, S, D]; mask rides per batch.
-    qf = q.reshape(b, kh, g, d).reshape(b * kh, g, d)
-    kf = k.transpose(0, 2, 1, 3).reshape(b * kh, s, d)
-    vf = v.transpose(0, 2, 1, 3).reshape(b * kh, s, d)
+    # ZERO-COPY contract: decode is HBM-bound on streaming the cache slab,
+    # so the kernel reads K/V in their NATIVE [B, S, K, D] layout via 4-D
+    # BlockSpecs — no transpose/pad materialization of the slabs (an early
+    # version transposed both, doubling the very traffic the kernel exists
+    # to avoid).  q's head split [B,1,H,D]→[B,1,K,G,D] is a free reshape.
+    qf = q.reshape(b, kh, g, d)  # [B, K, G, D]
 
+    # block_s must divide s (padding k/v would copy the whole slab; Mosaic
+    # edge-padding reads undefined bytes that 0*NaN could leak through).
+    # Callers size caches to 8-aligned capacities, so the largest divisor
+    # ≤ block_s is near block_s in practice; worst case degrades to more
+    # grid steps, never to wrong results.
     block_s = min(block_s, max(s, 1))
-    s_pad = (-s) % block_s
-    if s_pad:
-        kf = jnp.pad(kf, ((0, 0), (0, s_pad), (0, 0)))
-        vf = jnp.pad(vf, ((0, 0), (0, s_pad), (0, 0)))
-        mask = jnp.pad(mask, ((0, 0), (0, s_pad)))  # pads masked out
-    sp = s + s_pad
+    while s % block_s:
+        block_s -= 1
 
-    grid = (b * kh, sp // block_s)
+    grid = (b, kh, s // block_s)
     out = pl.pallas_call(
         functools.partial(_decode_kernel, scale=scale, softcap=logit_softcap),
-        out_shape=jax.ShapeDtypeStruct((b * kh, g, d), out_dtype),
+        out_shape=jax.ShapeDtypeStruct((b, kh, g, d), out_dtype),
         grid=grid,
         in_specs=[
-            pl.BlockSpec((1, g, d), lambda bk, j: (bk, 0, 0),
+            pl.BlockSpec((1, 1, g, d), lambda bi, ki, j: (bi, ki, 0, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, block_s, d), lambda bk, j: (bk, j, 0),
+            pl.BlockSpec((1, block_s, 1, d), lambda bi, ki, j: (bi, j, ki, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, block_s, d), lambda bk, j: (bk, j, 0),
+            pl.BlockSpec((1, block_s, 1, d), lambda bi, ki, j: (bi, j, ki, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, block_s), lambda bk, j, _kh=kh: (bk // _kh, j),
+            pl.BlockSpec((1, block_s), lambda bi, ki, j: (bi, j),
                          memory_space=pltpu.VMEM),
         ],
-        out_specs=pl.BlockSpec((1, g, d), lambda bk, j: (bk, 0, 0),
+        out_specs=pl.BlockSpec((1, 1, g, d), lambda bi, ki, j: (bi, ki, 0, 0),
                                memory_space=pltpu.VMEM),
         scratch_shapes=[
             pltpu.VMEM((g, 1), jnp.float32),
@@ -147,6 +150,6 @@ def decode_attention(
             pltpu.VMEM((g, d), jnp.float32),
         ],
         interpret=interpret,
-    )(qf, kf, vf, mask)
+    )(qf, k, v, mask)
 
-    return out.reshape(b, kh, g, d).reshape(b, 1, h, d)
+    return out.reshape(b, 1, h, d)

@@ -93,6 +93,36 @@ class MeshPlan:
                 )
 
 
+def parse_mesh_spec(text: str) -> MeshPlan:
+    """CLI mesh syntax → MeshPlan, shared by the inference and training
+    CLIs: named axes ``data=2,pipe=2,model=2`` (any of data/seq/model/
+    pipe/expert) or the positional ``data,seq,model`` triple.  Raises
+    SystemExit with a usage message on any malformed input (axis typos,
+    non-integer values, wrong arity)."""
+    axes = ("data", "seq", "model", "pipe", "expert")
+    usage = (
+        f"--mesh {text!r}: use named axes like data=2,pipe=2,model=2 "
+        "(axes: data/seq/model/pipe/expert) or the positional "
+        "data,seq,model triple"
+    )
+    kw = {}
+    parts = [p for p in text.split(",") if p]
+    try:
+        if parts and all("=" in p for p in parts):
+            for p in parts:
+                name, _, val = p.partition("=")
+                if name not in axes:
+                    raise SystemExit(f"unknown mesh axis {name!r}; {usage}")
+                kw[name] = int(val)
+        elif len(parts) == 3 and not any("=" in p for p in parts):
+            kw = dict(zip(("data", "seq", "model"), (int(p) for p in parts)))
+        else:
+            raise SystemExit(usage)
+    except ValueError:
+        raise SystemExit(usage) from None
+    return MeshPlan(**kw)
+
+
 def make_mesh(plan: MeshPlan, devices: list | None = None) -> Mesh:
     devices = devices if devices is not None else jax.devices()
     n = plan.num_devices
