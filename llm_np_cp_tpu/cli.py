@@ -200,13 +200,11 @@ def _run_tpu(args) -> str:
         params = shard_params(params, config, plan, mesh)
 
     if args.speculative > 0 and (
-        args.attn_impl or args.flash_prefill or args.prefill_chunk
-        or args.decode_attn != "xla"
+        args.attn_impl or args.flash_prefill or args.decode_attn != "xla"
     ):
         raise SystemExit(
             "--speculative uses its own fused draft/verify pipeline; "
-            "--attn-impl/--flash-prefill/--prefill-chunk/--decode-attn "
-            "do not apply to it"
+            "--attn-impl/--flash-prefill/--decode-attn do not apply to it"
         )
     attn_impl = args.attn_impl or ("flash" if args.flash_prefill else "xla")
     if attn_impl == "ring" and (mesh is None or seq <= 1):
@@ -237,7 +235,7 @@ def _run_tpu(args) -> str:
         with ctx:
             spec = SpeculativeGenerator(
                 params, config, gamma=args.speculative, sampler=sampler,
-                cache_dtype=cache_dtype,
+                cache_dtype=cache_dtype, prefill_chunk=args.prefill_chunk,
             )
             prompt_ids = tok(args.prompt, return_tensors="np")["input_ids"][0]
             res = spec.generate(

@@ -315,6 +315,7 @@ class SpeculativeGenerator:
         sampler: Sampler | None = None,
         draft_sampler: Sampler | None = None,
         cache_dtype: jnp.dtype = jnp.bfloat16,
+        prefill_chunk: int | None = None,
     ) -> None:
         if draft_params is None:
             from llm_np_cp_tpu.quant import is_quantized, quantize_params
@@ -331,8 +332,18 @@ class SpeculativeGenerator:
         self.draft_config = draft_config or config
         self.gamma = gamma
         self.sampler = sampler or Sampler()
-        self._prefill_t = make_prefill_fn(config, self.sampler)
-        self._prefill_d = make_prefill_fn(self.draft_config, self.sampler)
+        if prefill_chunk:
+            from llm_np_cp_tpu.generate import make_chunked_prefill_fn
+
+            self._prefill_t = make_chunked_prefill_fn(
+                config, self.sampler, prefill_chunk
+            )
+            self._prefill_d = make_chunked_prefill_fn(
+                self.draft_config, self.sampler, prefill_chunk
+            )
+        else:
+            self._prefill_t = make_prefill_fn(config, self.sampler)
+            self._prefill_d = make_prefill_fn(self.draft_config, self.sampler)
         self._draft_sampler = draft_sampler
         self._loops: dict[tuple, Any] = {}  # fused loop per stop-token set
         self.cache_dtype = cache_dtype

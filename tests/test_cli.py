@@ -222,11 +222,22 @@ def test_cli_decode_attn_pallas_matches_xla(fake_load, capsys):
     assert a == b
 
 
-def test_cli_speculative_rejects_prefill_flags(fake_load):
-    """--speculative has its own pipeline; prefill flags must not be
-    silently dropped."""
-    for extra in (["--attn-impl=ring"], ["--prefill-chunk=4"],
+def test_cli_speculative_rejects_attn_flags(fake_load):
+    """--speculative has its own pipeline; attention-impl flags must not
+    be silently dropped (--prefill-chunk IS supported there)."""
+    for extra in (["--attn-impl=ring"], ["--decode-attn=pallas"],
                   ["--flash-prefill"]):
         with pytest.raises(SystemExit, match="do not apply"):
             cli.run(["--backend=tpu", "--speculative=2", "--max-tokens=2",
                      "--dtype=f32"] + extra)
+
+
+def test_cli_speculative_chunked_prefill(fake_load, capsys):
+    """--speculative composes with --prefill-chunk (both caches are
+    prefilled chunk-wise; greedy output is unchanged)."""
+    a = cli.run(["--backend=tpu", "--speculative=2", "--sampler=greedy",
+                 "--max-tokens=6", "--dtype=f32", "--prefill-chunk=3",
+                 "--prompt=hello"])
+    b = cli.run(["--backend=tpu", "--sampler=greedy", "--max-tokens=6",
+                 "--dtype=f32", "--no-stream", "--prompt=hello"])
+    assert a == b
