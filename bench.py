@@ -194,7 +194,7 @@ def _tree_bytes(tree) -> int:
     return sum(x.size * x.dtype.itemsize for x in jax.tree.leaves(tree))
 
 
-def _chained_reps(one, seed_prompt, vocab_size, reps=3, on_warm=None):
+def _chained_reps(one, seed_prompt, vocab_size, reps=3):
     """Run ``one(prompt_host, tag)`` reps+1 times (first is compile warmup)
     with FRESH inputs each rep, chained through the previous output — the
     tunneled transport dedupes repeated executions with identical live
@@ -203,21 +203,20 @@ def _chained_reps(one, seed_prompt, vocab_size, reps=3, on_warm=None):
     ``one`` returns a result dict that includes ``"chain"``: an int derived
     from a materialized (host) output, proving the execution completed and
     perturbing the next prompt; ``tag`` ("warmup"/"repN") lets it emit
-    bench-phase breadcrumbs.  Returns the ``reps`` measured dicts.
-    ``on_warm`` (if given) is called with the warmup wall-clock — the
-    compile-phase cost, reported separately from the measured reps.
+    bench-phase breadcrumbs.  Returns ``(warm_s, results)``: the warmup
+    wall-clock (the compile-phase cost, reported separately) and the
+    ``reps`` measured dicts.
     """
     carry = seed_prompt
     t0 = time.perf_counter()
     out = one(carry, "warmup")  # compile
-    if on_warm is not None:
-        on_warm(time.perf_counter() - t0)
+    warm_s = time.perf_counter() - t0
     results = []
     for i in range(reps):
         carry = (carry + out["chain"] + i + 1) % vocab_size
         out = one(carry, f"rep{i}")
         results.append(out)
-    return results
+    return warm_s, results
 
 
 def _measure_decode(name, config, params, prefill, loop, batch, prompt_len,
@@ -258,16 +257,14 @@ def _measure_decode(name, config, params, prefill, loop, batch, prompt_len,
             "chain": int(toks_host.sum()),
         }
 
-    compile_s = [0.0]
-    runs = _chained_reps(
+    compile_s, runs = _chained_reps(
         one, rng.integers(0, config.vocab_size, (batch, prompt_len)),
         config.vocab_size, reps,
-        on_warm=lambda dt: compile_s.__setitem__(0, dt),
     )
     return (
         float(np.median([r["ttft"] for r in runs])),
         float(np.median([r["rate"] for r in runs])),
-        compile_s[0],
+        compile_s,
     )
 
 
@@ -353,10 +350,9 @@ def run_prefill_config(name: str) -> dict:
         _phase(name, f"{tag}:prefill_done", t_start, dt=round(dt, 1))
         return {"ttft": dt, "chain": int(out.sum())}
 
-    compile_s = [0.0]
-    runs = _chained_reps(
+    compile_s, runs = _chained_reps(
         one, rng.integers(0, config.vocab_size, (1, prompt_len)),
-        config.vocab_size, on_warm=lambda dt: compile_s.__setitem__(0, dt),
+        config.vocab_size,
     )
     ttft = float(np.median([r["ttft"] for r in runs]))
     return {
@@ -367,7 +363,7 @@ def run_prefill_config(name: str) -> dict:
         "prompt_len": prompt_len,
         "attn_impl": spec["attn_impl"],
         **({"chunk": chunk} if chunk else {}),
-        "compile_s": round(compile_s[0], 1),
+        "compile_s": round(compile_s, 1),
     }
 
 
@@ -396,7 +392,7 @@ def run_spec_config(name: str) -> dict:
             "chain": int(res.tokens.sum()),
         }
 
-    runs = _chained_reps(
+    _, runs = _chained_reps(
         one, rng.integers(0, config.vocab_size, (batch, prompt_len)),
         config.vocab_size,
     )
@@ -473,6 +469,18 @@ def _spawn(mode: str, timeout: float) -> dict:
             "diagnosis": _diagnose_timeout(phases, timeout),
             "last_phases": phases[-4:],
         }
+    for line in reversed(proc.stdout.strip().splitlines()):
+        try:
+            return json.loads(line)
+        except json.JSONDecodeError:
+            continue
+    tail = (proc.stderr or proc.stdout or "").strip().splitlines()[-8:]
+    return {
+        "config": mode,
+        "ok": False,
+        "error": f"rc={proc.returncode}, no JSON line",
+        "tail": "\n".join(tail)[-800:],
+    }
 
 
 def _diagnose_timeout(phases: list[str], timeout: float) -> str:
@@ -500,18 +508,6 @@ def _diagnose_timeout(phases: list[str], timeout: float) -> str:
     else:
         nxt = "the next phase"
     return f"reached {name!r} at t={t}s, then burned the rest in {nxt}"
-    for line in reversed(proc.stdout.strip().splitlines()):
-        try:
-            return json.loads(line)
-        except json.JSONDecodeError:
-            continue
-    tail = (proc.stderr or proc.stdout or "").strip().splitlines()[-8:]
-    return {
-        "config": mode,
-        "ok": False,
-        "error": f"rc={proc.returncode}, no JSON line",
-        "tail": "\n".join(tail)[-800:],
-    }
 
 
 def _emit_summary(detail: dict, probe: dict, error: str | None) -> None:

@@ -125,24 +125,19 @@ def make_chunked_prefill_fn(
     if chunk_size < 1:
         raise ValueError(f"chunk_size must be >= 1, got {chunk_size}")
 
-    @partial(jax.jit, donate_argnums=(2,))
-    def chunk_step(params: Params, ids: jnp.ndarray, cache: KVCache):
-        logits, cache = forward(
-            params, ids, config, cache, logits_last_only=True
-        )
-        return logits[:, -1], cache
-
-    if attn_impl == "xla":
-        first_step = chunk_step
-    else:
-
+    def _make_step(impl: str):
         @partial(jax.jit, donate_argnums=(2,))
-        def first_step(params: Params, ids: jnp.ndarray, cache: KVCache):
+        def step(params: Params, ids: jnp.ndarray, cache: KVCache):
             logits, cache = forward(
                 params, ids, config, cache, logits_last_only=True,
-                attn_impl=attn_impl,
+                attn_impl=impl,
             )
             return logits[:, -1], cache
+
+        return step
+
+    chunk_step = _make_step("xla")
+    first_step = chunk_step if attn_impl == "xla" else _make_step(attn_impl)
 
     def prefill_chunked(
         params: Params,
