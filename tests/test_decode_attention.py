@@ -114,6 +114,34 @@ def test_generator_rejects_unknown_decode_impl():
         Generator(params, cfg, decode_attn_impl="pallas")
 
 
+def test_decode_loop_under_tp_mesh_parity():
+    """flash_decode inside a TP=4-sharded decode loop emits the same
+    tokens as single-device XLA (JAX reshards around the pallas_call;
+    whether that's FAST is the bench's question, correctness is ours)."""
+    from llm_np_cp_tpu.config import tiny_config
+    from llm_np_cp_tpu.generate import Generator
+    from llm_np_cp_tpu.models.transformer import init_params
+    from llm_np_cp_tpu.ops.sampling import Sampler
+    from llm_np_cp_tpu.parallel.sharding import (
+        MeshPlan, make_mesh, shard_params,
+    )
+
+    cfg = tiny_config("llama")
+    params = init_params(jax.random.PRNGKey(0), cfg, dtype=jnp.float32)
+    prompt = np.random.default_rng(1).integers(0, cfg.vocab_size, (14,))
+    want = Generator(params, cfg, sampler=Sampler(kind="greedy"),
+                     cache_dtype=jnp.float32).generate(prompt, 8).tokens
+
+    plan = MeshPlan(model=4)
+    mesh = make_mesh(plan)
+    p_sh = shard_params(params, cfg, plan, mesh)
+    with jax.set_mesh(mesh):
+        got = Generator(p_sh, cfg, sampler=Sampler(kind="greedy"),
+                        cache_dtype=jnp.float32,
+                        decode_attn_impl="flash_decode").generate(prompt, 8).tokens
+    np.testing.assert_array_equal(want, got)
+
+
 def test_ragged_batch_parity():
     """Left-padded ragged batches: pad holes are invisible via the mask."""
     from llm_np_cp_tpu.config import tiny_config
