@@ -1,0 +1,97 @@
+"""bench.py harness invariants (offline, BENCH_PLATFORM=cpu children).
+
+The bench artifact is the round's headline evidence; a harness regression
+(e.g. a helper accidentally spliced into _spawn's success path, caught in
+round 3) silently destroys it.  These tests pin the parent-side machinery
+without a TPU: child spawn round-trip, timeout diagnosis, summary
+emission, and the PRIORITY/config-dict sync assert.
+"""
+
+import json
+import sys
+
+import pytest
+
+sys.path.insert(0, __file__.rsplit("/tests/", 1)[0])
+import bench
+
+
+@pytest.fixture(autouse=True)
+def _cpu_children(monkeypatch):
+    monkeypatch.setenv("BENCH_PLATFORM", "cpu")
+
+
+def test_spawn_success_roundtrip():
+    """A successful child returns its parsed result dict — the exact path
+    that silently returned None in an early round-3 edit."""
+    res = bench._spawn("smoke_tiny", 300)
+    assert res is not None and res.get("ok") is True, res
+    assert res["config"] == "smoke_tiny"
+    assert res["decode_tok_s_chip"] > 0
+    assert "compile_s" in res
+
+
+def test_spawn_timeout_carries_diagnosis():
+    res = bench._spawn("smoke_tiny", 1)
+    assert res["ok"] is False
+    assert "timeout" in res["error"]
+    assert "diagnosis" in res
+
+
+def test_diagnose_timeout_phases():
+    mk = lambda phase, t: "bench-phase " + json.dumps(
+        {"config": "x", "phase": phase, "t": t}
+    )
+    assert "backend init" in bench._diagnose_timeout([], 600)
+    assert "prefill compile" in bench._diagnose_timeout(
+        [mk("params_built", 5.0)], 600
+    )
+    assert "decode-loop compile" in bench._diagnose_timeout(
+        [mk("warmup:prefill_done", 50.0)], 600
+    )
+    assert "execution" in bench._diagnose_timeout(
+        [mk("rep1:decode_done", 400.0)], 600
+    )
+
+
+def test_emit_summary_always_parseable(capsys):
+    detail = {
+        "llama1b_bs8": {"config": "llama1b_bs8", "ok": True,
+                        "decode_tok_s_chip": 2000.0, "per_seq_tok_s": 250.0},
+        "gemma2_2b_bs1": {"config": "gemma2_2b_bs1", "ok": False,
+                          "error": "timeout after 540s"},
+    }
+    bench._emit_summary(detail, {"ok": True}, error=bench._failed_error(detail))
+    line = capsys.readouterr().out.strip().splitlines()[-1]
+    d = json.loads(line)
+    assert d["value"] == 2000.0
+    assert d["vs_baseline"] == 2.0
+    assert "gemma2_2b_bs1" in d["error"]
+
+
+def test_failed_error_ignores_warm():
+    detail = {
+        "warm": {"config": "warm", "ok": False, "error": "timeout"},
+        "llama1b_bs8": {"config": "llama1b_bs8", "ok": True},
+    }
+    assert bench._failed_error(detail) is None
+
+
+def test_priority_matches_config_dicts():
+    """Import-time assert is live: every non-smoke config is prioritized."""
+    non_smoke = {
+        n
+        for n in list(bench.DECODE_CONFIGS) + list(bench.SPEC_CONFIGS)
+        + list(bench.PREFILL_CONFIGS)
+        if not n.startswith("smoke")
+    }
+    assert set(bench.PRIORITY) == non_smoke
+
+
+def test_warm_smoke_offline():
+    """The warm child AOT-compiles all configs from abstract shapes on the
+    CPU backend without error (cache-priming path the matrix runs first)."""
+    res = bench._spawn("warm", 600)
+    assert res.get("ok") is True, res
+    assert set(res["warmed"]) == {n for n in bench.PRIORITY
+                                 if n not in bench.SPEC_CONFIGS}
