@@ -87,6 +87,11 @@ DECODE_CONFIGS = {
     "llama3b_seq2048_bs8": dict(
         model="llama3b", batch=8, prompt_len=2048, decode_tokens=64, sampler="top_p"
     ),
+    # int8 KV cache at the long-context shape: cache HBM stream halves
+    "llama3b_seq2048_bs8_kvq8": dict(
+        model="llama3b", batch=8, prompt_len=2048, decode_tokens=64,
+        sampler="top_p", cache_dtype="int8",
+    ),
     # not in the default matrix: offline smoke test of the measurement path
     "smoke_tiny": dict(model="tiny", batch=2, prompt_len=16, decode_tokens=8),
 }
@@ -120,6 +125,7 @@ PRIORITY = [
     "llama1b_bs8_fdec",   # Pallas decode-attention experiment vs bs8
     "llama3b_seq2048_bs8",  # 3B params: the most expensive, last
     "int8_bs1",
+    "llama3b_seq2048_bs8_kvq8",  # after int8_bs1: don't displace prior coverage
 ]
 # every non-smoke config must be in PRIORITY — a config added to the dicts
 # but not the ordering would otherwise silently never run
@@ -220,7 +226,8 @@ def _chained_reps(one, seed_prompt, vocab_size, reps=3):
 
 
 def _measure_decode(name, config, params, prefill, loop, batch, prompt_len,
-                    decode_tokens, reps=3, t_start=None):
+                    decode_tokens, reps=3, t_start=None,
+                    cache_dtype=None):
     """Median TTFT + aggregate decode rate over ``reps`` fresh-input runs.
 
     Warmup is split into two timed phases (prefill compile, decode-loop
@@ -240,8 +247,10 @@ def _measure_decode(name, config, params, prefill, loop, batch, prompt_len,
     if t_start is None:
         t_start = time.perf_counter()
 
+    cache_dtype = cache_dtype or jnp.bfloat16
+
     def one(prompt_host, tag):
-        cache = KVCache.init(config, batch, max_seq, dtype=jnp.bfloat16)
+        cache = KVCache.init(config, batch, max_seq, dtype=cache_dtype)
         t0 = time.perf_counter()
         tok0, cache, _ = prefill(params, jnp.asarray(prompt_host, jnp.int32), cache, key)
         np.asarray(tok0)  # force real D2H — block_until_ready is not a fence here
@@ -285,16 +294,23 @@ def run_decode_config(name: str) -> dict:
     )
     batch, prompt_len, decode_tokens = spec["batch"], spec["prompt_len"], spec["decode_tokens"]
 
+    import jax.numpy as jnp
+
+    kv_quant = spec.get("cache_dtype") == "int8"
     ttft, rate, compile_s = _measure_decode(
         name, config, params, prefill, loop, batch, prompt_len, decode_tokens,
-        t_start=t0,
+        t_start=t0, cache_dtype=jnp.int8 if kv_quant else None,
     )
 
     # Roofline accounting: each decode step streams the full weight set plus
     # the valid KV prefix for every sequence (mean length over the run).
     param_bytes = _tree_bytes(params)
     mean_len = prompt_len + decode_tokens / 2
-    kv_bytes_per_tok = config.num_hidden_layers * 2 * config.num_key_value_heads * config.head_dim * 2
+    kv_elem_bytes = 1 + 4 / config.head_dim if kv_quant else 2
+    kv_bytes_per_tok = int(
+        config.num_hidden_layers * 2 * config.num_key_value_heads
+        * config.head_dim * kv_elem_bytes
+    )
     step_bytes = param_bytes + batch * mean_len * kv_bytes_per_tok
     steps_per_s = rate / batch
     hbm_gb_s = steps_per_s * step_bytes / 1e9
@@ -455,9 +471,10 @@ def run_warm() -> dict:
         prompt_len = spec["prompt_len"]
         decode_tokens = spec.get("decode_tokens")
         max_seq = prompt_len + (decode_tokens or 0) + 8
+        cdt = jnp.int8 if spec.get("cache_dtype") == "int8" else jnp.bfloat16
         cache = jax.eval_shape(
-            lambda c=config, b=batch, m=max_seq: KVCache.init(
-                c, b, m, dtype=jnp.bfloat16
+            lambda c=config, b=batch, m=max_seq, dt=cdt: KVCache.init(
+                c, b, m, dtype=dt
             )
         )
         ids = jax.ShapeDtypeStruct((batch, prompt_len), jnp.int32)

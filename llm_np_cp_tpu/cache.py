@@ -38,6 +38,11 @@ class KVCache(NamedTuple):
     v: jnp.ndarray  # [L, B, S_max, K, D]
     valid: jnp.ndarray  # [B, S_max] bool — written AND not a pad token
     length: jnp.ndarray  # int32 scalar
+    # int8 cache mode (dtype=jnp.int8): per-token-per-head absmax scales;
+    # None for float caches.  Halves cache HBM traffic for long-context
+    # decode (scales are D=1/64..1/128 of the slab).
+    k_scale: jnp.ndarray | None = None  # [L, B, S_max, K] f32
+    v_scale: jnp.ndarray | None = None
 
     @classmethod
     def init(
@@ -54,12 +59,19 @@ class KVCache(NamedTuple):
             config.num_key_value_heads,
             config.head_dim,
         )
+        quantized = dtype == jnp.int8
         return cls(
             k=jnp.zeros(shape, dtype=dtype),
             v=jnp.zeros(shape, dtype=dtype),
             valid=jnp.zeros((batch_size, max_seq_len), dtype=jnp.bool_),
             length=jnp.zeros((), dtype=jnp.int32),
+            k_scale=jnp.zeros(shape[:-1], jnp.float32) if quantized else None,
+            v_scale=jnp.zeros(shape[:-1], jnp.float32) if quantized else None,
         )
+
+    @property
+    def quantized(self) -> bool:
+        return self.k_scale is not None
 
     @property
     def max_seq_len(self) -> int:
@@ -85,12 +97,7 @@ def truncate(cache: KVCache, new_length: jnp.ndarray) -> KVCache:
     new_length = jnp.asarray(new_length, jnp.int32)
     bound = new_length[:, None] if new_length.ndim == 1 else new_length
     keep = jnp.arange(cache.max_seq_len, dtype=jnp.int32)[None, :] < bound
-    return KVCache(
-        k=cache.k,
-        v=cache.v,
-        valid=cache.valid & keep,
-        length=new_length,
-    )
+    return cache._replace(valid=cache.valid & keep, length=new_length)
 
 
 def update_layer(
@@ -115,17 +122,60 @@ def update_layer(
     """
     k_new = k_new.astype(k_layer.dtype)
     v_new = v_new.astype(v_layer.dtype)
-    zero = jnp.zeros((), dtype=jnp.int32)
+    return (
+        _write_at(k_layer, k_new, offset),
+        _write_at(v_layer, v_new, offset),
+    )
+
+
+def _write_at(slab: jnp.ndarray, new: jnp.ndarray, offset: jnp.ndarray) -> jnp.ndarray:
+    """dynamic_update_slice of ``new`` into ``slab`` along the seq axis
+    (axis 1 of a [B, S_max, ...] array of any trailing rank), at a scalar
+    offset or per-row [B] offsets (vmapped)."""
+    trail = (jnp.zeros((), jnp.int32),) * (slab.ndim - 2)
     if offset.ndim == 1:
         import jax
 
-        def one(kl, vl, kn, vn, off):
-            return (
-                lax.dynamic_update_slice(kl, kn, (off, zero, zero)),
-                lax.dynamic_update_slice(vl, vn, (off, zero, zero)),
-            )
+        return jax.vmap(
+            lambda sl, nw, off: lax.dynamic_update_slice(sl, nw, (off, *trail))
+        )(slab, new, offset)
+    zero = jnp.zeros((), jnp.int32)
+    return lax.dynamic_update_slice(slab, new, (zero, offset, *trail))
 
-        return jax.vmap(one)(k_layer, v_layer, k_new, v_new, offset)
-    k_layer = lax.dynamic_update_slice(k_layer, k_new, (zero, offset, zero, zero))
-    v_layer = lax.dynamic_update_slice(v_layer, v_new, (zero, offset, zero, zero))
-    return k_layer, v_layer
+
+def quantize_kv(x: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Per-token-per-head symmetric int8: x [..., D] float →
+    (int8 [..., D], f32 absmax/127 scale [...])."""
+    scale = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1) / 127.0
+    safe = jnp.where(scale == 0.0, 1.0, scale)
+    q = jnp.clip(jnp.round(x.astype(jnp.float32) / safe[..., None]), -127, 127)
+    return q.astype(jnp.int8), scale.astype(jnp.float32)
+
+
+def dequantize_kv(q: jnp.ndarray, scale: jnp.ndarray, dtype: jnp.dtype) -> jnp.ndarray:
+    """int8 [..., D] × scale [...] → float [..., D].  Left unfused here on
+    purpose: XLA folds the convert+multiply into the attention einsum's
+    operand, so HBM reads stay int8."""
+    return q.astype(dtype) * scale[..., None].astype(dtype)
+
+
+def update_layer_quantized(
+    k_layer: jnp.ndarray,
+    v_layer: jnp.ndarray,
+    ks_layer: jnp.ndarray,
+    vs_layer: jnp.ndarray,
+    k_new: jnp.ndarray,
+    v_new: jnp.ndarray,
+    offset: jnp.ndarray,
+) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """update_layer for the int8 cache: quantize the new tokens' K/V
+    (per-token-per-head absmax) and write values + scales at ``offset``.
+    Returns (k_layer, v_layer, ks_layer, vs_layer) updated."""
+    kq, ks = quantize_kv(k_new)
+    vq, vs = quantize_kv(v_new)
+    return (
+        _write_at(k_layer, kq, offset),
+        _write_at(v_layer, vq, offset),
+        _write_at(ks_layer, ks, offset),
+        _write_at(vs_layer, vs, offset),
+    )

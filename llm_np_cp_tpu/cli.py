@@ -42,6 +42,11 @@ def build_parser(default_model: str) -> argparse.ArgumentParser:
     p.add_argument("--temperature", type=float, default=1.0)
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--dtype", choices=["bf16", "f32"], default="bf16")
+    p.add_argument("--cache-dtype", choices=["auto", "bf16", "f32", "int8"],
+                   default="auto",
+                   help="KV-cache storage dtype (auto = follow --dtype); "
+                        "int8 stores per-token-per-head absmax-quantized "
+                        "K/V, halving cache HBM traffic for long contexts")
     p.add_argument("--quantize", choices=["none", "int8"], default="none",
                    help="int8 = weight-only quantization (halves decode HBM "
                         "traffic; composes with --mesh sharding)")
@@ -219,7 +224,12 @@ def _run_tpu(args) -> str:
         top_k=args.top_k, top_p=args.top_p,
     )
     eos = getattr(tok, "eos_token_id", None)
-    cache_dtype = jnp.bfloat16 if args.dtype == "bf16" else jnp.float32
+    cache_dtype = {
+        "auto": jnp.bfloat16 if args.dtype == "bf16" else jnp.float32,
+        "bf16": jnp.bfloat16,
+        "f32": jnp.float32,
+        "int8": jnp.int8,
+    }[args.cache_dtype]
 
     import contextlib
 

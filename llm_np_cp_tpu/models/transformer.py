@@ -33,7 +33,12 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from llm_np_cp_tpu.cache import KVCache, update_layer
+from llm_np_cp_tpu.cache import (
+    KVCache,
+    dequantize_kv,
+    update_layer,
+    update_layer_quantized,
+)
 from llm_np_cp_tpu.config import ModelConfig
 from llm_np_cp_tpu.ops.activations import ACT2FN, softcap
 from llm_np_cp_tpu.ops.attention import causal_mask, gqa_attention
@@ -461,43 +466,68 @@ def forward(
     )
     act = ACT2FN[config.hidden_act]
 
+    quantized = cache is not None and cache.quantized
     if cache is not None:
         k_cache, v_cache = cache.k, cache.v
+        ks_cache = cache.k_scale if quantized else jnp.zeros((num_layers, 0))
+        vs_cache = cache.v_scale if quantized else jnp.zeros((num_layers, 0))
     else:
         # Scan still needs per-layer xs of uniform shape; use zero-size dummies.
         k_cache = jnp.zeros((num_layers, 0), dtype=act_dtype)
         v_cache = jnp.zeros((num_layers, 0), dtype=act_dtype)
+        ks_cache = jnp.zeros((num_layers, 0))
+        vs_cache = jnp.zeros((num_layers, 0))
 
     def layer_step(x: jnp.ndarray, xs: tuple) -> tuple[jnp.ndarray, tuple]:
-        w, k_l, v_l, sliding = xs
+        w, k_l, v_l, ks_l, vs_l, sliding = xs
         x_in = x  # layer input (collected when output_hidden_states)
-        kv_update = (
-            (lambda k, v: update_layer(k_l, v_l, k, v, offset))
-            if cache is not None
-            else None
-        )
+        written = {}  # int8 mode: slabs+scales stashed by the write hook
+        if quantized:
+
+            def kv_update(k, v):
+                slabs = update_layer_quantized(
+                    k_l, v_l, ks_l, vs_l, k, v, offset
+                )
+                written["slabs"] = slabs
+                # attention reads the dequantized view; XLA fuses the
+                # convert+scale into the einsum operand, so the HBM read
+                # of the slab stays int8
+                return (
+                    dequantize_kv(slabs[0], slabs[2], k.dtype),
+                    dequantize_kv(slabs[1], slabs[3], v.dtype),
+                )
+
+        elif cache is not None:
+            kv_update = lambda k, v: update_layer(k_l, v_l, k, v, offset)
+        else:
+            kv_update = None
         x, kv_att, attn_weights, moe_aux = run_decoder_layer(
             w, x, config=config, act=act, cos=cos, sin=sin,
             mask_global=mask_global, mask_local=mask_local,
             sliding=sliding, attn_impl=attn_impl, kv_update=kv_update,
             output_attentions=output_attentions,
         )
-        if cache is not None:
+        if quantized:
+            k_l, v_l, ks_l, vs_l = written["slabs"]
+        elif cache is not None:
             k_l, v_l = kv_att  # updated cache slabs (flash also writes them)
 
-        ys: tuple = (k_l, v_l, moe_aux)
+        ys: tuple = (k_l, v_l, ks_l, vs_l, moe_aux)
         if output_hidden_states:
             ys += (x_in,)
         if output_attentions:
             ys += (attn_weights,)
         return x, ys
 
-    x, scan_out = lax.scan(layer_step, x, (lp, k_cache, v_cache, is_sliding))
+    x, scan_out = lax.scan(
+        layer_step, x, (lp, k_cache, v_cache, ks_cache, vs_cache, is_sliding)
+    )
     new_k, new_v = scan_out[0], scan_out[1]
+    new_ks, new_vs = scan_out[2], scan_out[3]
     aux: dict[str, jnp.ndarray] = {}
     if config.is_moe and output_router_losses:
-        aux["moe_aux_loss"] = jnp.mean(scan_out[2])  # mean over layers
-    pos_idx = 3
+        aux["moe_aux_loss"] = jnp.mean(scan_out[4])  # mean over layers
+    pos_idx = 5
     if output_hidden_states:
         aux["hidden_states"] = scan_out[pos_idx]  # [L, B, S, H] layer inputs
         pos_idx += 1
@@ -509,7 +539,9 @@ def forward(
     new_cache = None
     if cache is not None:
         new_cache = KVCache(
-            k=new_k, v=new_v, valid=cache_valid, length=offset + s
+            k=new_k, v=new_v, valid=cache_valid, length=offset + s,
+            k_scale=new_ks if quantized else None,
+            v_scale=new_vs if quantized else None,
         )
 
     if output_hidden_states:

@@ -192,19 +192,23 @@ def param_specs(config: ModelConfig, plan: MeshPlan) -> dict[str, Any]:
     return specs
 
 
-def cache_specs(config: ModelConfig, plan: MeshPlan) -> Any:
+def cache_specs(config: ModelConfig, plan: MeshPlan, quantized: bool = False) -> Any:
     """KVCache sharding: [L, B, S, K, D] — batch on data, kv-heads on model
-    (when divisible), seq on the seq axis for context parallelism."""
+    (when divisible), seq on the seq axis for context parallelism.  The
+    int8 cache's scale slabs [L, B, S, K] shard like the values minus D."""
     from llm_np_cp_tpu.cache import KVCache
 
     d = DATA_AXIS if plan.data > 1 else None
     kv = MODEL_AXIS if _kv_heads_shardable(config, plan) else None
     s = SEQ_AXIS if plan.seq > 1 else None
+    scale = P(None, d, s, kv) if quantized else None
     return KVCache(
         k=P(None, d, s, kv, None),
         v=P(None, d, s, kv, None),
         valid=P(d, s),
         length=P(),
+        k_scale=scale,
+        v_scale=scale,
     )
 
 
@@ -262,5 +266,7 @@ def shard_params(params: Any, config: ModelConfig, plan: MeshPlan, mesh: Mesh) -
 
 
 def shard_cache(cache: Any, config: ModelConfig, plan: MeshPlan, mesh: Mesh) -> Any:
-    shardings = to_shardings(mesh, cache_specs(config, plan))
+    shardings = to_shardings(
+        mesh, cache_specs(config, plan, quantized=cache.k_scale is not None)
+    )
     return jax.tree.map(jax.device_put, cache, shardings)
