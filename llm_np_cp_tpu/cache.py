@@ -145,7 +145,13 @@ def _write_at(slab: jnp.ndarray, new: jnp.ndarray, offset: jnp.ndarray) -> jnp.n
 
 def quantize_kv(x: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
     """Per-token-per-head symmetric int8: x [..., D] float →
-    (int8 [..., D], f32 absmax/127 scale [...])."""
+    (int8 [..., D], f32 absmax/127 scale [...]).
+
+    Same numeric contract as quant.quantize_array (weight-side int8) but
+    activation-shaped: squeezed scale tuple instead of a keepdims dict,
+    and the amax==0 guard keeps scale 0 (slot reads as exact zero) rather
+    than mapping it to 1.  Keep the two in sync if the contract changes.
+    """
     scale = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1) / 127.0
     safe = jnp.where(scale == 0.0, 1.0, scale)
     q = jnp.clip(jnp.round(x.astype(jnp.float32) / safe[..., None]), -127, 127)

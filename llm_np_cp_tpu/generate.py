@@ -268,15 +268,6 @@ class Generator:
                 f"decode_attn_impl must be 'xla' or 'flash_decode', "
                 f"got {decode_attn_impl!r}"
             )
-        if decode_attn_impl == "flash_decode" and cache_dtype == jnp.int8:
-            # the Pallas kernel's operands can't absorb the dequant the
-            # way the XLA einsum does — XLA would materialize full bf16
-            # slab copies every step, INVERTING the int8 bandwidth win
-            raise ValueError(
-                "decode_attn_impl='flash_decode' does not compose with the "
-                "int8 KV cache (the dequantized slabs would be "
-                "materialized every step); use the XLA decode path"
-            )
         if prefill_chunk:
             self._prefill = make_chunked_prefill_fn(
                 config, self.sampler, prefill_chunk, prefill_attn_impl

@@ -272,10 +272,18 @@ def run_decoder_layer(
         # same mask as the XLA path (validity ∧ window ∧ ragged pads), so
         # every decode feature works unchanged.  Prefill/chunked calls
         # (s > 1) under this impl fall through to the XLA path below.
+        # An int8 cache arrives as (values, scales) tuples: the kernel
+        # streams 1-byte slabs and dequantizes in VMEM.
         from llm_np_cp_tpu.ops.pallas.decode_attention import decode_attention
 
+        if isinstance(k_att, tuple):
+            (k_vals, k_sc), (v_vals, v_sc) = k_att, v_att
+        else:
+            k_vals, k_sc, v_vals, v_sc = k_att, None, v_att, None
         attn = decode_attention(
-            q, k_att, v_att, jnp.broadcast_to(mask, (b, 1, k_att.shape[1]))[:, 0],
+            q, k_vals, v_vals,
+            jnp.broadcast_to(mask, (b, 1, k_vals.shape[1]))[:, 0],
+            k_scale=k_sc, v_scale=v_sc,
             scale=config.attn_scale,
             logit_softcap=config.attn_logit_softcapping,
         )
@@ -489,7 +497,11 @@ def forward(
                     k_l, v_l, ks_l, vs_l, k, v, offset
                 )
                 written["slabs"] = slabs
-                # attention reads the dequantized view; XLA fuses the
+                if attn_impl == "flash_decode" and k.shape[1] == 1:
+                    # the decode kernel reads int8 + scales natively —
+                    # hand it the raw slabs as (values, scales) pairs
+                    return (slabs[0], slabs[2]), (slabs[1], slabs[3])
+                # XLA attention reads the dequantized view; XLA fuses the
                 # convert+scale into the einsum operand, so the HBM read
                 # of the slab stays int8
                 return (

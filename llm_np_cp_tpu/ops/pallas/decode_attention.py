@@ -34,9 +34,13 @@ NEG_INF = float(jnp.finfo(jnp.float32).min)
 
 
 def _decode_kernel(
-    q_ref, k_ref, v_ref, mask_ref, o_ref, m_ref, l_ref, acc_ref,
-    *, scale: float, softcap: float | None,
+    *refs, scale: float, softcap: float | None, quantized: bool,
 ):
+    if quantized:
+        (q_ref, k_ref, v_ref, mask_ref, ks_ref, vs_ref,
+         o_ref, m_ref, l_ref, acc_ref) = refs
+    else:
+        q_ref, k_ref, v_ref, mask_ref, o_ref, m_ref, l_ref, acc_ref = refs
     j = pl.program_id(2)  # kv block (innermost: scratch accumulates per (b,kh))
     nj = pl.num_programs(2)
 
@@ -49,6 +53,11 @@ def _decode_kernel(
     q = q_ref[0, 0].astype(jnp.float32)  # [G, D]
     k = k_ref[0, :, 0].astype(jnp.float32)  # [block_s, D]
     v = v_ref[0, :, 0].astype(jnp.float32)
+    if quantized:
+        # int8 cache: HBM streams 1-byte values; dequant happens here in
+        # VMEM (the XLA path fuses the same multiply into its einsum)
+        k = k * ks_ref[0, :, 0][:, None]
+        v = v * vs_ref[0, :, 0][:, None]
 
     s = jax.lax.dot_general(
         q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
@@ -90,6 +99,8 @@ def decode_attention(
     v: jnp.ndarray,
     mask: jnp.ndarray,
     *,
+    k_scale: jnp.ndarray | None = None,
+    v_scale: jnp.ndarray | None = None,
     scale: float,
     logit_softcap: float | None = None,
     block_s: int = 512,
@@ -101,10 +112,27 @@ def decode_attention(
     → [B, 1, H, D].  Equivalent to ``gqa_attention(q, k, v, mask[:,None,:])``
     — verified against it in tests.
 
+    int8 cache mode: pass k/v as int8 with ``k_scale``/``v_scale``
+    [B, S, K] (cache.quantize_kv layout); the kernel streams 1-byte
+    values from HBM and dequantizes in VMEM — the combination that would
+    otherwise materialize full dequantized slabs per step.
+
     interpret=None auto-selects: compiled on TPU, interpreter elsewhere.
     """
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
+    quantized = k_scale is not None
+    if (
+        quantized != (k.dtype == jnp.int8)
+        or quantized != (v.dtype == jnp.int8)
+        or quantized != (v_scale is not None)
+    ):
+        raise ValueError(
+            "int8 k AND v require both k_scale and v_scale (and vice "
+            f"versa); got k={k.dtype}, v={v.dtype}, "
+            f"k_scale={'set' if k_scale is not None else None}, "
+            f"v_scale={'set' if v_scale is not None else None}"
+        )
     b, one, h, d = q.shape
     assert one == 1, f"decode_attention is q_len=1 only, got {one}"
     _, s, kh, _ = k.shape
@@ -128,20 +156,32 @@ def decode_attention(
         block_s -= 1
 
     grid = (b, kh, s // block_s)
+    in_specs = [
+        pl.BlockSpec((1, 1, g, d), lambda bi, ki, j: (bi, ki, 0, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, block_s, 1, d), lambda bi, ki, j: (bi, j, ki, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, block_s, 1, d), lambda bi, ki, j: (bi, j, ki, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, block_s), lambda bi, ki, j: (bi, j),
+                     memory_space=pltpu.VMEM),
+    ]
+    operands = [qf, k, v, mask]
+    if quantized:
+        scale_spec = pl.BlockSpec(
+            (1, block_s, 1), lambda bi, ki, j: (bi, j, ki),
+            memory_space=pltpu.VMEM,
+        )
+        in_specs += [scale_spec, scale_spec]
+        operands += [k_scale, v_scale]
     out = pl.pallas_call(
-        functools.partial(_decode_kernel, scale=scale, softcap=logit_softcap),
+        functools.partial(
+            _decode_kernel, scale=scale, softcap=logit_softcap,
+            quantized=quantized,
+        ),
         out_shape=jax.ShapeDtypeStruct((b, kh, g, d), out_dtype),
         grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, 1, g, d), lambda bi, ki, j: (bi, ki, 0, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, block_s, 1, d), lambda bi, ki, j: (bi, j, ki, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, block_s, 1, d), lambda bi, ki, j: (bi, j, ki, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, block_s), lambda bi, ki, j: (bi, j),
-                         memory_space=pltpu.VMEM),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((1, 1, g, d), lambda bi, ki, j: (bi, ki, 0, 0),
                                memory_space=pltpu.VMEM),
         scratch_shapes=[
@@ -150,6 +190,6 @@ def decode_attention(
             pltpu.VMEM((g, d), jnp.float32),
         ],
         interpret=interpret,
-    )(qf, k, v, mask)
+    )(*operands)
 
     return out.reshape(b, 1, h, d)
