@@ -80,6 +80,8 @@ DECODE_CONFIGS = {
     "llama1b_bs32": dict(model="llama1b", batch=32, prompt_len=128, decode_tokens=128),
     "int8_bs1": dict(model="llama1b", batch=1, prompt_len=128, decode_tokens=256, quant=True),
     "int8_bs8": dict(model="llama1b", batch=8, prompt_len=128, decode_tokens=256, quant=True),
+    "int4_bs8": dict(model="llama1b", batch=8, prompt_len=128, decode_tokens=256,
+                     quant="int4"),
     "gemma2_2b_bs1": dict(model="gemma2_2b", batch=1, prompt_len=128, decode_tokens=256),
     # the fused Pallas decode-attention experiment (keep only if it wins)
     "llama1b_bs8_fdec": dict(model="llama1b", batch=8, prompt_len=128,
@@ -117,6 +119,7 @@ PRIORITY = [
     "gemma2_2b_bs1",      # BASELINE config 2 — never captured
     "llama1b_bs1",        # r2's one captured number (cached compile)
     "int8_bs8",           # VERDICT task 7
+    "int4_bs8",           # weight stream quarters vs bf16
     "int8_spec_bs8",      # VERDICT task 7
     "prefill8k_chunked",  # BASELINE config 5 via chunked prefill
     "prefill8k_flash",
@@ -171,7 +174,7 @@ def _child_jax():
     return jax
 
 
-def _build_model(name: str, quant: bool = False):
+def _build_model(name: str, quant=False):
     import jax
     import jax.numpy as jnp
 
@@ -187,10 +190,10 @@ def _build_model(name: str, quant: bool = False):
     # Random bf16 weights — no checkpoint downloads in this environment;
     # decode throughput is weight-value-independent.
     params = init_params(jax.random.PRNGKey(0), config, dtype=jnp.bfloat16)
-    if quant:
+    if quant:  # True/"int8" → 8-bit, "int4" → 4-bit
         from llm_np_cp_tpu.quant import quantize_params
 
-        params = quantize_params(params)
+        params = quantize_params(params, bits=4 if quant == "int4" else 8)
     return config, params
 
 
@@ -462,7 +465,7 @@ def run_warm() -> dict:
             if quant:
                 from llm_np_cp_tpu.quant import quantize_params
 
-                params = quantize_params(params)
+                params = quantize_params(params, bits=4 if quant == "int4" else 8)
             return params
 
         params = jax.eval_shape(_abstract_params)

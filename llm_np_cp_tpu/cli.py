@@ -47,9 +47,11 @@ def build_parser(default_model: str) -> argparse.ArgumentParser:
                    help="KV-cache storage dtype (auto = follow --dtype); "
                         "int8 stores per-token-per-head absmax-quantized "
                         "K/V, halving cache HBM traffic for long contexts")
-    p.add_argument("--quantize", choices=["none", "int8"], default="none",
-                   help="int8 = weight-only quantization (halves decode HBM "
-                        "traffic; composes with --mesh sharding)")
+    p.add_argument("--quantize", choices=["none", "int8", "int4"],
+                   default="none",
+                   help="weight-only quantization: int8 halves decode HBM "
+                        "traffic, int4 packs projections two-per-byte "
+                        "(embed stays int8); composes with --mesh sharding")
     p.add_argument("--mesh", default="1,1,1",
                    help="data,seq,model parallel degrees (e.g. 1,1,8 for TP=8)")
     p.add_argument("--max-seq-len", type=int, default=None,
@@ -194,10 +196,12 @@ def _run_tpu(args) -> str:
 
     tok, params, config = _load(args)
 
-    if args.quantize == "int8":
+    if args.quantize != "none":
         from llm_np_cp_tpu.quant import quantize_params
 
-        params = quantize_params(params)
+        params = quantize_params(
+            params, bits=4 if args.quantize == "int4" else 8
+        )
     mesh = None
     if plan.num_devices > 1:
         plan.validate(config)

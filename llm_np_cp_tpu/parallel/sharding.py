@@ -229,7 +229,10 @@ def _scale_spec(spec: P, leaf: dict) -> P:
     """PartitionSpec for a quantized leaf's scale tensor: the weight's spec
     with contracted (size-1 in the scale, >1 in the payload) axes cleared —
     a size-1 axis cannot be sharded."""
-    q, s = leaf["q"], leaf["s"]
+    from llm_np_cp_tpu.quant import payload_key
+
+    q = leaf[payload_key(leaf)]
+    s = leaf["s"]
     entries = list(spec) + [None] * (q.ndim - len(spec))
     return P(*[
         None if (s.shape[i] == 1 and q.shape[i] != 1) else entries[i]
@@ -251,8 +254,11 @@ def shard_params(params: Any, config: ModelConfig, plan: MeshPlan, mesh: Mesh) -
 
     def place(spec: P, leaf: Any) -> Any:
         if is_quantized(leaf):
+            from llm_np_cp_tpu.quant import payload_key
+
+            pk = payload_key(leaf)
             return {
-                "q": jax.device_put(leaf["q"], NamedSharding(mesh, spec)),
+                pk: jax.device_put(leaf[pk], NamedSharding(mesh, spec)),
                 "s": jax.device_put(
                     leaf["s"], NamedSharding(mesh, _scale_spec(spec, leaf))
                 ),

@@ -9,9 +9,12 @@ matmul read: XLA folds the ``int8 → bf16`` convert and the scale multiply
 into the GEMM's operand pipeline, so HBM traffic halves while the MXU
 still runs bf16×bf16.
 
-Representation: a quantized matrix is the dict ``{"q": int8, "s": f32}``
-(same pytree position as the original array), with ``s`` broadcast along
-the *input* axis:
+Representation: a quantized matrix is a dict in the original array's
+pytree position — ``{"q": int8, "s": f32}`` (8-bit) or ``{"q4": uint8
+two-nibbles-per-byte packed along the contraction axis, "s": f32}``
+(4-bit; see quantize_array4) — with ``s`` broadcast along the *input*
+axis (consumers: ``payload()`` / ``payload_key()`` below, quant_einsum,
+sharding.shard_params):
 
 - projections ``[in, out]`` → per-out-channel scale ``[out]``
 - stacked layers ``[L, in, out]`` → ``[L, 1, out]``
@@ -43,7 +46,16 @@ _QUANT_KEYS = {
 
 
 def is_quantized(w: Any) -> bool:
-    return isinstance(w, dict) and "q" in w and "s" in w
+    return isinstance(w, dict) and ("q" in w or "q4" in w) and "s" in w
+
+
+def payload_key(w: dict) -> str:
+    return "q" if "q" in w else "q4"
+
+
+def payload(w: dict) -> jnp.ndarray:
+    """The quantized leaf's full-width integer payload (int4 unpacked)."""
+    return w["q"] if "q" in w else _unpack4(w["q4"])
 
 
 def quantize_array(w: jnp.ndarray, *, axis: int) -> dict[str, jnp.ndarray]:
@@ -57,34 +69,72 @@ def quantize_array(w: jnp.ndarray, *, axis: int) -> dict[str, jnp.ndarray]:
     return {"q": q, "s": s.astype(jnp.float32)}
 
 
+def quantize_array4(w: jnp.ndarray, *, axis: int = -2) -> dict[str, jnp.ndarray]:
+    """Symmetric int4: q ∈ [-7, 7], stored offset-binary (q+8) two values
+    per uint8, packed along the CONTRACTION axis (must be ``-2`` and even
+    — every projection's in-dim is).  Payload is in-dim/2 × 1 byte: a
+    quarter of bf16, half of int8."""
+    if axis != -2:
+        raise NotImplementedError("int4 packing is along axis -2 only")
+    if w.shape[-2] % 2:
+        raise ValueError(f"contraction dim {w.shape[-2]} must be even for int4")
+    w32 = w.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(w32), axis=axis, keepdims=True)
+    s = jnp.where(amax > 0, amax / 7.0, 1.0)
+    q = (jnp.clip(jnp.round(w32 / s), -7, 7) + 8).astype(jnp.uint8)
+    qr = q.reshape(*q.shape[:-2], q.shape[-2] // 2, 2, q.shape[-1])
+    packed = qr[..., 0, :] | (qr[..., 1, :] << 4)
+    return {"q4": packed, "s": s.astype(jnp.float32)}
+
+
+def _unpack4(p: jnp.ndarray) -> jnp.ndarray:
+    """uint8 [..., in/2, out] → int8 [..., in, out] (row 2i = low nibble).
+    Pure elementwise bit ops + an adjacent-dim reshape, so XLA can keep it
+    inside the GEMM operand's fusion (benchmark-gated, like the int8 path)."""
+    lo = (p & jnp.uint8(0xF)).astype(jnp.int8) - 8
+    hi = (p >> jnp.uint8(4)).astype(jnp.int8) - 8
+    st = jnp.stack([lo, hi], axis=-2)  # [..., in/2, 2, out]
+    return st.reshape(*p.shape[:-2], p.shape[-2] * 2, p.shape[-1])
+
+
 def dequantize(w: Any, dtype: jnp.dtype = jnp.float32) -> jnp.ndarray:
     if not is_quantized(w):
         return w
-    return (w["q"].astype(jnp.float32) * w["s"]).astype(dtype)
+    return (payload(w).astype(jnp.float32) * w["s"]).astype(dtype)
 
 
-def quantize_params(params: Params, *, embed: bool = True) -> Params:
+def quantize_params(params: Params, *, embed: bool = True, bits: int = 8) -> Params:
     """Quantize every projection matrix (and optionally the embedding /
     tied lm_head table) of a transformer param pytree in place-shape.
+
+    ``bits=4`` packs the projections two-per-byte (quarter of bf16); the
+    embedding/lm_head stay int8 — per-row int4 on the gather table costs
+    visible quality for a small byte win, and the lm_head matmul is once
+    per step, not per layer.
 
     The result drops into ``models.transformer.forward`` unchanged —
     ``_project`` / ``embed_inputs`` / ``final_logits`` detect the dict
     leaves — and into ``parallel.sharding.shard_params``, which shards the
-    int8 payload like the original weight and the scales alongside it.
+    payload like the original weight and the scales alongside it.
     """
+    if bits not in (4, 8):
+        raise ValueError(f"bits must be 4 or 8, got {bits}")
+    qproj = quantize_array4 if bits == 4 else quantize_array
     out = dict(params)
     layers = dict(params["layers"])
     for key in list(layers.keys()):
         if key in _QUANT_KEYS:
             # stacked [L, in, out] (dense) or [L, E, in, out] (MoE experts):
             # contraction axis is always -2
-            layers[key] = quantize_array(layers[key], axis=-2)
+            layers[key] = qproj(layers[key], axis=-2)
     out["layers"] = layers
     if embed:
         # [V, H]: per-row scales serve both the embed gather and the tied
         # lm_head (row = vocab output channel)
         out["embed_tokens"] = quantize_array(params["embed_tokens"], axis=-1)
     if "lm_head" in params:
+        # int8 even at bits=4: the lm_head matmul runs once per step (not
+        # per layer) and sets output-logit quality
         out["lm_head"] = quantize_array(params["lm_head"], axis=-2)
     return out
 
@@ -106,14 +156,14 @@ def _align_scale(spec: str, s: jnp.ndarray) -> jnp.ndarray:
 
 
 def quant_einsum(spec: str, x: jnp.ndarray, w: Any) -> jnp.ndarray:
-    """``einsum(spec, x, w)`` in f32 accumulation, accepting either a plain
-    array or a quantized ``{"q", "s"}`` dict for ``w`` (matmul the int8
+    """``einsum(spec, x, w)`` in f32 accumulation, accepting a plain array
+    or a quantized ``{"q"|"q4", "s"}`` dict for ``w`` (matmul the unpacked
     payload in x.dtype, then rescale the output).  All weight-consuming
     einsums in the model go through this."""
     if not is_quantized(w):
         return jnp.einsum(spec, x, w, preferred_element_type=jnp.float32)
     y = jnp.einsum(
-        spec, x, w["q"].astype(x.dtype), preferred_element_type=jnp.float32
+        spec, x, payload(w).astype(x.dtype), preferred_element_type=jnp.float32
     )
     return y * _align_scale(spec, w["s"])
 

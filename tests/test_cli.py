@@ -124,6 +124,13 @@ def test_cli_quantize_composes_with_mesh(fake_load, capsys):
     assert text
 
 
+def test_cli_quantize_int4(fake_load, capsys):
+    text = cli.run(["--backend=tpu", "--quantize=int4", "--sampler=greedy",
+                    "--max-tokens=5", "--dtype=f32", "--no-stream",
+                    "--prompt=hello"])
+    assert isinstance(text, str) and text
+
+
 def test_cli_quantize_rejects_numpy_backend(fake_load):
     with pytest.raises(SystemExit, match="tpu backend only"):
         cli.run(["--backend=numpy", "--quantize=int8"])
