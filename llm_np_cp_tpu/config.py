@@ -53,6 +53,10 @@ class ModelConfig:
     hidden_act: str = "silu"
     tie_word_embeddings: bool = True
     attention_bias: bool = False
+    # o_proj bias: None = follow attention_bias (HF Llama puts a bias on
+    # all four attention projections); False = Qwen-2's pattern (Q/K/V
+    # biased, o_proj not)
+    attention_out_bias: bool | None = None
     mlp_bias: bool = False
 
     # --- RoPE scaling (llama-3 style). The reference ignores `rope_scaling`
@@ -99,6 +103,12 @@ class ModelConfig:
     @property
     def is_moe(self) -> bool:
         return self.num_local_experts is not None
+
+    @property
+    def o_proj_bias(self) -> bool:
+        if self.attention_out_bias is not None:
+            return self.attention_out_bias
+        return self.attention_bias
 
     @property
     def num_query_groups(self) -> int:
@@ -175,6 +185,15 @@ class ModelConfig:
                 sliding_window=d.get("sliding_window"),
                 query_pre_attn_scalar=d.get("query_pre_attn_scalar"),
                 hidden_act=d.get("hidden_activation", d.get("hidden_act", "gelu_pytorch_tanh")),
+            )
+        if model_type == "qwen2":
+            # Qwen-2/2.5: llama architecture with Q/K/V projection biases
+            # and an unbiased o_proj (HF Qwen2Attention), untied head on
+            # the larger sizes
+            kwargs.update(
+                attention_bias=True,
+                attention_out_bias=False,
+                tie_word_embeddings=d.get("tie_word_embeddings", False),
             )
         return cls(**kwargs)
 
@@ -278,12 +297,41 @@ GEMMA_2_9B = dataclasses.replace(
     head_dim=256,
 )
 
+QWEN_2_5_0_5B = ModelConfig(
+    model_type="qwen2",
+    vocab_size=151936,
+    hidden_size=896,
+    intermediate_size=4864,
+    num_hidden_layers=24,
+    num_attention_heads=14,
+    num_key_value_heads=2,
+    head_dim=64,
+    max_position_embeddings=32768,
+    rope_theta=1000000.0,
+    rms_norm_eps=1e-6,
+    tie_word_embeddings=True,
+    attention_bias=True,
+    attention_out_bias=False,
+)
+
+QWEN_2_5_1_5B = dataclasses.replace(
+    QWEN_2_5_0_5B,
+    hidden_size=1536,
+    intermediate_size=8960,
+    num_hidden_layers=28,
+    num_attention_heads=12,
+    num_key_value_heads=2,
+    head_dim=128,
+)
+
 PRESETS: dict[str, ModelConfig] = {
     "meta-llama/Llama-3.2-1B": LLAMA_3_2_1B,
     "meta-llama/Llama-3.2-3B": LLAMA_3_2_3B,
     "meta-llama/Llama-3.1-8B": LLAMA_3_1_8B,
     "google/gemma-2-2b": GEMMA_2_2B,
     "google/gemma-2-9b": GEMMA_2_9B,
+    "Qwen/Qwen2.5-0.5B": QWEN_2_5_0_5B,
+    "Qwen/Qwen2.5-1.5B": QWEN_2_5_1_5B,
 }
 
 
@@ -312,6 +360,12 @@ def tiny_config(model_type: str = "llama", **overrides: Any) -> ModelConfig:
             attn_logit_softcapping=50.0,
             sliding_window=16,
             query_pre_attn_scalar=16.0,
+        )
+    if model_type == "qwen2":
+        base.update(
+            attention_bias=True,
+            attention_out_bias=False,
+            tie_word_embeddings=True,
         )
     base.update(overrides)
     return ModelConfig(**base)

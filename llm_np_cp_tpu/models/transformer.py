@@ -72,11 +72,14 @@ def param_shapes(config: ModelConfig) -> dict[str, Any]:
     }
     if config.attention_bias:
         # HF Llama-family attention_bias puts a bias on all four attention
-        # projections (Qwen-2-style checkpoints)
+        # projections; Qwen-2 biases only Q/K/V (attention_out_bias=False)
         layers.update(
-            q_bias=(L, NH * D), k_bias=(L, NK * D),
-            v_bias=(L, NK * D), o_bias=(L, H),
+            q_bias=(L, NH * D), k_bias=(L, NK * D), v_bias=(L, NK * D),
         )
+    if config.o_proj_bias:
+        # independent gate: o_proj_bias defaults to attention_bias but an
+        # explicit attention_out_bias=True stands alone too
+        layers.update(o_bias=(L, H))
     if config.mlp_bias:
         if config.is_moe:
             raise NotImplementedError("mlp_bias is not supported for MoE configs")

@@ -41,16 +41,15 @@ import numpy as np
 from safetensors import safe_open
 
 from llm_np_cp_tpu.config import ModelConfig
-from llm_np_cp_tpu.models import gemma2, llama
+from llm_np_cp_tpu.models import gemma2, llama, qwen2
 from llm_np_cp_tpu.models.transformer import param_shapes
 
 _LAYER_RE = re.compile(r"^model\.layers\.(\d+)\.(.+)$")
 
 
 def _key_maps(config: ModelConfig):
-    if config.model_type == "gemma2":
-        return gemma2.LAYER_KEY_MAP, gemma2.TOP_KEY_MAP
-    return llama.LAYER_KEY_MAP, llama.TOP_KEY_MAP
+    family = {"gemma2": gemma2, "qwen2": qwen2}.get(config.model_type, llama)
+    return family.LAYER_KEY_MAP, family.TOP_KEY_MAP
 
 
 def _np_dtype(dtype) -> np.dtype:
@@ -162,6 +161,18 @@ def load_params(
                         continue  # e.g. rotary inv_freq buffers
                     name, transpose = layer_map[suffix]
                     if name not in host["layers"]:
+                        if name.endswith("_bias"):
+                            # A bias tensor the config gated OFF is
+                            # PRESENT in the checkpoint — loading would
+                            # silently drop it and produce wrong logits
+                            # (the round-1 silent-wrongness class)
+                            raise ValueError(
+                                f"{key}: checkpoint carries this bias but "
+                                f"the config disables it "
+                                f"(attention_bias={config.attention_bias}, "
+                                f"attention_out_bias={config.attention_out_bias}, "
+                                f"mlp_bias={config.mlp_bias})"
+                            )
                         continue
                     fill(f, native, key, host["layers"][name][idx], transpose)
                     filled.add(f"layers.{name}.{idx}")

@@ -203,6 +203,21 @@ def test_numpy_oracle_bias_changes_logits():
     assert np.abs(with_b - without_b).max() > 1e-4
 
 
+def test_numpy_oracle_qwen2_bias_pattern_parity():
+    """Qwen-2 pattern (Q/K/V biased, o_proj not): oracle == jax."""
+    from llm_np_cp_tpu.backends.numpy_ref import forward_np
+
+    cfg = tiny_config("qwen2")
+    assert cfg.attention_bias and not cfg.o_proj_bias
+    params = init_params(jax.random.PRNGKey(9), cfg, dtype=jnp.float32)
+    assert "q_bias" in params["layers"] and "o_bias" not in params["layers"]
+    ids = np.random.default_rng(9).integers(0, cfg.vocab_size, (2, 7))
+    want, _ = forward(params, jnp.asarray(ids, jnp.int32), cfg)
+    p_np = jax.tree.map(lambda x: np.asarray(x, np.float32), params)
+    got, _ = forward_np(p_np, ids.astype(np.int32), cfg)
+    np.testing.assert_allclose(got, np.asarray(want), atol=1e-5, rtol=1e-5)
+
+
 def test_moe_mlp_bias_rejected():
     cfg = tiny_config("llama", num_local_experts=4, num_experts_per_tok=2, mlp_bias=True)
     with pytest.raises(NotImplementedError, match="mlp_bias"):

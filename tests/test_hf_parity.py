@@ -110,6 +110,64 @@ def test_gemma2_logits_match_hf(tmp_path):
     _assert_logits_match(tmp_path, hf, _ids(seed=2), atol=5e-3)
 
 
+def test_qwen2_logits_match_hf(tmp_path):
+    """Qwen-2 family: Q/K/V biases present, o_proj bias ABSENT — the HF
+    checkpoint simply has no o_proj.bias tensor, and our param_shapes
+    gates on attention_out_bias=False, so load + forward must agree."""
+    cfg = transformers.Qwen2Config(
+        **TINY, rope_theta=10000.0, rms_norm_eps=1e-6,
+        tie_word_embeddings=True,
+    )
+    torch.manual_seed(4)
+    hf = transformers.Qwen2ForCausalLM(cfg).eval()
+    # HF inits the qkv biases to zeros; perturb them so the check is live
+    with torch.no_grad():
+        for name, p in hf.named_parameters():
+            if name.endswith(".bias"):
+                p.copy_(torch.randn_like(p) * 0.1)
+    hf.save_pretrained(tmp_path, safe_serialization=True)
+    _assert_logits_match(tmp_path, hf, _ids(seed=4))
+
+
+def test_qwen2_cached_decode_matches_hf_generate(tmp_path):
+    cfg = transformers.Qwen2Config(
+        **TINY, rope_theta=10000.0, rms_norm_eps=1e-6,
+        tie_word_embeddings=True,
+    )
+    torch.manual_seed(5)
+    hf = transformers.Qwen2ForCausalLM(cfg).eval()
+    hf.save_pretrained(tmp_path, safe_serialization=True)
+    params, mcfg = load_params(tmp_path, dtype=jnp.float32)
+    assert mcfg.attention_bias and not mcfg.o_proj_bias
+    ids = _ids(8, seed=5)
+
+    from llm_np_cp_tpu.generate import Generator
+    from llm_np_cp_tpu.ops.sampling import Sampler
+
+    gen = Generator(params, mcfg, sampler=Sampler(kind="greedy"),
+                    cache_dtype=jnp.float32)
+    ours = gen.generate(ids[0], 10).tokens[0]
+    with torch.no_grad():
+        theirs = hf.generate(
+            torch.from_numpy(ids), max_new_tokens=10, do_sample=False,
+            use_cache=True,
+        )[0, ids.shape[1]:].numpy()
+    np.testing.assert_array_equal(ours, theirs)
+
+
+def test_checkpoint_bias_config_mismatch_rejected(tmp_path):
+    """A checkpoint that CARRIES bias tensors while the config disables
+    them must fail loudly — silently dropping them prints wrong text."""
+    hf = _save_hf_llama(tmp_path, attention_bias=True, mlp_bias=True)
+    cfg_path = tmp_path / "config.json"
+    d = json.loads(cfg_path.read_text())
+    d["attention_bias"] = False
+    d["mlp_bias"] = False
+    cfg_path.write_text(json.dumps(d))
+    with pytest.raises(ValueError, match="carries this bias"):
+        load_params(tmp_path, dtype=jnp.float32)
+
+
 def test_llama_cached_decode_matches_hf_generate(tmp_path):
     """Greedy decode through OUR cache path == HF greedy generate."""
     hf = _save_hf_llama(tmp_path)
