@@ -1,7 +1,7 @@
 """Model family assembly (the role of SURVEY §2.6/§2.7's L3 layer).
 
-One generic decoder (`transformer.py`) covers both families; `llama.py` and
-`gemma2.py` bind family-specific config/param naming.  Params are a plain
+One generic decoder (`transformer.py`) covers every family; `llama.py`,
+`gemma2.py`, and `qwen2.py` bind family-specific config/param naming.  Params are a plain
 dict pytree with layer weights stacked on a leading axis for
 ``lax.scan`` — no weight-owning classes, no global ``weights`` dict
 (the reference loads weights inside every constructor,
