@@ -220,3 +220,23 @@ def test_int4_sharded_matches_unsharded():
     np.testing.assert_allclose(
         np.asarray(got), np.asarray(want), atol=2e-4, rtol=1e-4
     )
+
+
+def test_speculative_with_int4_target():
+    """An int4-quantized target self-drafts (nothing cheaper to derive):
+    the speculative loop must emit the plain generator's greedy tokens."""
+    from llm_np_cp_tpu.generate import Generator
+    from llm_np_cp_tpu.ops.sampling import Sampler
+    from llm_np_cp_tpu.speculative import SpeculativeGenerator
+
+    cfg = tiny_config("llama")
+    params = init_params(jax.random.PRNGKey(6), cfg, dtype=jnp.float32)
+    q4 = quantize_params(params, bits=4)
+    prompt = np.random.default_rng(6).integers(0, cfg.vocab_size, (8,))
+    want = Generator(q4, cfg, sampler=Sampler(kind="greedy"),
+                     cache_dtype=jnp.float32).generate(prompt, 10).tokens[0]
+    got = SpeculativeGenerator(
+        q4, cfg, gamma=2, sampler=Sampler(kind="greedy"),
+        cache_dtype=jnp.float32,
+    ).generate(prompt, 10).tokens
+    np.testing.assert_array_equal(want, got)
