@@ -70,6 +70,7 @@ import sys
 import time
 
 HBM_GB_S = 819.0  # TPU v5e HBM bandwidth spec
+PEAK_BF16_FLOP_S = 197e12  # TPU v5e bf16 peak (MFU denominator)
 NORTH_STAR_TOK_S = 1000.0  # BASELINE.json north_star
 REPO = os.path.dirname(os.path.abspath(__file__))
 
@@ -86,6 +87,10 @@ DECODE_CONFIGS = {
     # the fused Pallas decode-attention experiment (keep only if it wins)
     "llama1b_bs8_fdec": dict(model="llama1b", batch=8, prompt_len=128,
                              decode_tokens=256, decode_attn="flash_decode"),
+    # flagship combo: Pallas decode kernel streaming the int8 KV cache
+    "llama1b_bs8_fdec_kvq8": dict(model="llama1b", batch=8, prompt_len=128,
+                                  decode_tokens=256, decode_attn="flash_decode",
+                                  cache_dtype="int8"),
     "llama3b_seq2048_bs8": dict(
         model="llama3b", batch=8, prompt_len=2048, decode_tokens=64, sampler="top_p"
     ),
@@ -126,6 +131,7 @@ PRIORITY = [
     "prefill8k_xla",
     "llama1b_bs32",
     "llama1b_bs8_fdec",   # Pallas decode-attention experiment vs bs8
+    "llama1b_bs8_fdec_kvq8",  # Pallas kernel reading the int8 KV cache
     "llama3b_seq2048_bs8",  # 3B params: the most expensive, last
     "int8_bs1",
     "llama3b_seq2048_bs8_kvq8",  # after int8_bs1: don't displace prior coverage
@@ -242,10 +248,11 @@ def _measure_decode(name, config, params, prefill, loop, batch, prompt_len,
     import jax.numpy as jnp
     import numpy as np
 
-    from llm_np_cp_tpu.cache import KVCache
+    from llm_np_cp_tpu.cache import KVCache, align_capacity
 
     key = jax.random.PRNGKey(0)
-    max_seq = prompt_len + decode_tokens + 8
+    # the same capacity sizing Generator._init_cache uses in production
+    max_seq = align_capacity(prompt_len + decode_tokens + 8)
     rng = np.random.default_rng(batch)
     if t_start is None:
         t_start = time.perf_counter()
@@ -338,7 +345,7 @@ def run_prefill_config(name: str) -> dict:
     import jax.numpy as jnp
     import numpy as np
 
-    from llm_np_cp_tpu.cache import KVCache
+    from llm_np_cp_tpu.cache import KVCache, align_capacity
     from llm_np_cp_tpu.generate import make_chunked_prefill_fn, make_prefill_fn
     from llm_np_cp_tpu.ops.sampling import Sampler
 
@@ -361,7 +368,9 @@ def run_prefill_config(name: str) -> dict:
     rng = np.random.default_rng(0)
 
     def one(prompt_host, tag):
-        cache = KVCache.init(config, 1, prompt_len + 8, dtype=jnp.bfloat16)
+        cache = KVCache.init(
+            config, 1, align_capacity(prompt_len + 8), dtype=jnp.bfloat16
+        )
         t0 = time.perf_counter()
         tok0, _, _ = prefill(params, jnp.asarray(prompt_host, jnp.int32), cache, key)
         out = np.asarray(tok0)
@@ -374,11 +383,20 @@ def run_prefill_config(name: str) -> dict:
         config.vocab_size,
     )
     ttft = float(np.median([r["ttft"] for r in runs]))
+    # MFU vs the v5e bf16 peak (VERDICT r3 weak #5): matmul FLOPs are
+    # 2·N_params·S (the tied head's vocab matmul counts via N; the embed
+    # gather is free) plus causal attention 2·L·S²·H·D per QKᵀ/PV pair.
+    n_params = sum(x.size for x in jax.tree.leaves(params))
+    flops = 2.0 * n_params * prompt_len + (
+        2.0 * config.num_hidden_layers * prompt_len**2
+        * config.num_attention_heads * config.head_dim
+    )
     return {
         "config": name,
         "ok": True,
         "ttft_s_p50": round(ttft, 4),
         "prefill_tok_s": round(prompt_len / ttft, 1),
+        "mfu": round(flops / ttft / PEAK_BF16_FLOP_S, 4),
         "prompt_len": prompt_len,
         "attn_impl": spec["attn_impl"],
         **({"chunk": chunk} if chunk else {}),
@@ -442,7 +460,7 @@ def run_warm() -> dict:
     import jax
     import jax.numpy as jnp
 
-    from llm_np_cp_tpu.cache import KVCache
+    from llm_np_cp_tpu.cache import KVCache, align_capacity
     from llm_np_cp_tpu.config import GEMMA_2_2B, LLAMA_3_2_1B, LLAMA_3_2_3B, tiny_config
     from llm_np_cp_tpu.generate import make_decode_loop_fn, make_prefill_fn
     from llm_np_cp_tpu.models.transformer import init_params
@@ -473,7 +491,8 @@ def run_warm() -> dict:
         batch = spec.get("batch", 1)
         prompt_len = spec["prompt_len"]
         decode_tokens = spec.get("decode_tokens")
-        max_seq = prompt_len + (decode_tokens or 0) + 8
+        # keep in lockstep with _measure_decode's capacity sizing
+        max_seq = align_capacity(prompt_len + (decode_tokens or 0) + 8)
         cdt = jnp.int8 if spec.get("cache_dtype") == "int8" else jnp.bfloat16
         cache = jax.eval_shape(
             lambda c=config, b=batch, m=max_seq, dt=cdt: KVCache.init(
@@ -484,10 +503,19 @@ def run_warm() -> dict:
         try:
             chunk = spec.get("chunk")
             if chunk:
-                # chunked prefill = one chunk-wide program; warm that shape
+                # chunked prefill = one chunk-wide program; warm the SAME
+                # jitted step the measured path dispatches (its exposed
+                # chunk_step — logits-only, donated cache), not a
+                # make_prefill_fn lowered at the chunk shape, which is a
+                # different program and misses the cache (ADVICE r3 #2)
+                from llm_np_cp_tpu.generate import make_chunked_prefill_fn
+
                 ids = jax.ShapeDtypeStruct((batch, chunk), jnp.int32)
-                prefill = make_prefill_fn(config, sampler)
-                prefill.lower(params, ids, cache, key).compile()
+                chunked = make_chunked_prefill_fn(
+                    config, sampler, chunk_size=chunk,
+                    attn_impl=spec.get("attn_impl", "xla"),
+                )
+                chunked.chunk_step.lower(params, ids, cache).compile()
             else:
                 prefill = make_prefill_fn(
                     config, sampler, attn_impl=spec.get("attn_impl", "xla")
@@ -514,6 +542,56 @@ def run_warm() -> dict:
     }
 
 
+def run_kernels() -> dict:
+    """Mosaic compile probe for every Pallas kernel on the live backend
+    (VERDICT r3 task 2): tiny-shape compile+run each, record ok/error.
+    The same probes back Generator's runtime downgrade-to-XLA gate
+    (ops/pallas/support.py); this child makes the verdict a bench
+    artifact."""
+    import jax
+
+    from llm_np_cp_tpu.ops.pallas import support
+
+    t0 = time.perf_counter()
+    out = {"config": "kernels", "backend": jax.default_backend()}
+    failed = []
+    for kernel in ("softmax", "flash_attention", "decode_attention",
+                   "decode_attention_int8"):
+        err = support.kernel_error(kernel)
+        out[kernel] = "ok" if err is None else f"FAIL: {err[:300]}"
+        if err is not None:
+            failed.append(kernel)
+    out["ok"] = not failed
+    out["total_s"] = round(time.perf_counter() - t0, 1)
+    return out
+
+
+def run_quality() -> dict:
+    """Quantization quality evidence (VERDICT r3 task 4): greedy
+    divergence step + teacher-forced logit error per quant mode on the
+    tiny fixture.  Deterministic and backend-independent — the parent
+    runs it on CPU so it lands even when the TPU tunnel is down."""
+    import jax
+    import jax.numpy as jnp
+
+    from llm_np_cp_tpu.config import tiny_config
+    from llm_np_cp_tpu.models.transformer import init_params
+    from llm_np_cp_tpu.utils.quality import MODES, quant_quality
+
+    t0 = time.perf_counter()
+    cfg = tiny_config("llama")
+    params = init_params(jax.random.PRNGKey(7), cfg, dtype=jnp.float32)
+    out = {"config": "quality", "ok": True, "fixture": "tiny_llama_seed7"}
+    for mode in MODES:
+        _phase("quality", mode, t0)
+        out[mode] = {
+            k: v for k, v in quant_quality(cfg, params, mode, steps=128).items()
+            if k not in ("mode",)
+        }
+    out["total_s"] = round(time.perf_counter() - t0, 1)
+    return out
+
+
 def run_probe() -> dict:
     import jax
     import jax.numpy as jnp
@@ -537,6 +615,10 @@ def child_main(mode: str) -> None:
         out = run_probe()
     elif mode == "warm":
         out = run_warm()
+    elif mode == "kernels":
+        out = run_kernels()
+    elif mode == "quality":
+        out = run_quality()
     elif mode in DECODE_CONFIGS:
         out = run_decode_config(mode)
     elif mode in PREFILL_CONFIGS:
@@ -552,7 +634,7 @@ def child_main(mode: str) -> None:
 # Parent-process orchestration
 # ----------------------------------------------------------------------
 
-def _spawn(mode: str, timeout: float) -> dict:
+def _spawn(mode: str, timeout: float, env: dict | None = None) -> dict:
     """Run `python bench.py --run mode` with a hard timeout; parse the last
     JSON line of its stdout.  Never raises.  On timeout, the child's
     partial stderr (recovered from TimeoutExpired) yields the last
@@ -560,7 +642,8 @@ def _spawn(mode: str, timeout: float) -> dict:
     cmd = [sys.executable, os.path.abspath(__file__), "--run", mode]
     try:
         proc = subprocess.run(
-            cmd, capture_output=True, text=True, timeout=timeout, cwd=REPO
+            cmd, capture_output=True, text=True, timeout=timeout, cwd=REPO,
+            env={**os.environ, **(env or {})},
         )
     except subprocess.TimeoutExpired as e:
         err = e.stderr or b""
@@ -661,17 +744,59 @@ def main() -> None:
 
     t_start = time.time()
     deadline = _deadline_s()
-    # Probe with one retry: the tunnel has been observed to hang on first use.
-    probe = _spawn("probe", PROBE_TIMEOUT)
+    detail: dict[str, dict] = {}
+
+    # Opportunistic probing (VERDICT r3 task 1): the tunnel flaps — r3
+    # burned a 12 h session because the probe gave up 6 minutes into a
+    # 25-minute budget.  Keep probing every ~60 s across the ENTIRE
+    # budget (minus a reserve for the CPU-side quality child) until the
+    # chip answers; every attempt is logged so a dead-all-session tunnel
+    # still yields an artifact proving the coverage.
+    probe_log: list[dict] = []
+    reserve_s = 240.0  # keep room to still run the CPU quality child
+    while True:
+        attempt_start = time.time()
+        remaining = deadline - (attempt_start - t_start)
+        # always make at least one attempt, even under a tiny deadline
+        budget = min(PROBE_TIMEOUT, max(remaining - reserve_s, 60.0))
+        probe = _spawn("probe", budget)
+        probe_log.append({
+            "t": round(attempt_start - t_start, 1),
+            "ok": bool(probe.get("ok")),
+            **({} if probe.get("ok") else {"error": str(probe.get("error"))[:200]}),
+        })
+        if probe.get("ok"):
+            break
+        print(
+            f"bench: probe failed ({probe.get('error')}) at "
+            f"t={round(time.time() - t_start)}s; re-probing until "
+            f"deadline {round(deadline)}s",
+            file=sys.stderr, flush=True,
+        )
+        # keep the artifact honest mid-retry: a driver kill during the
+        # sleep must still leave an error-carrying summary
+        _emit_summary(
+            detail, {**probe, "probe_log": probe_log},
+            error=f"TPU backend unreachable so far: {probe.get('error')}",
+        )
+        if deadline - (time.time() - t_start) <= reserve_s + 70:
+            break
+        time.sleep(max(0.0, 60.0 - (time.time() - attempt_start)))
+    probe["probe_log"] = probe_log
+
     if not probe.get("ok"):
-        print(f"bench: probe failed ({probe.get('error')}), retrying", file=sys.stderr)
-        probe = _spawn("probe", PROBE_TIMEOUT)
-    if not probe.get("ok"):
-        _emit_summary({}, probe, error=f"TPU backend unreachable: {probe.get('error')}")
+        # TPU never answered: still produce the backend-independent
+        # quality evidence on CPU, then emit the probe-coverage artifact.
+        detail["quality"] = _spawn(
+            "quality", reserve_s, env={"BENCH_PLATFORM": "cpu"}
+        )
+        _emit_summary(
+            detail, probe,
+            error=f"TPU backend unreachable: {probe.get('error')}",
+        )
         return
 
     names = args.configs or list(PRIORITY)
-    detail: dict[str, dict] = {}
     if not args.configs:
         # AOT-warm the compilation cache first (abstract shapes, no
         # execution): one pass amortizes every config's compile.  Capped
@@ -682,6 +807,11 @@ def main() -> None:
         warm = _spawn("warm", min(420.0, max(remaining / 4, 60.0)))
         detail["warm"] = warm
         print(json.dumps(warm), file=sys.stderr, flush=True)
+        # Mosaic verdict per Pallas kernel — cheap (tiny shapes, warm
+        # cache) and the round's key hardware evidence
+        detail["kernels"] = _spawn("kernels", 240.0)
+        print(json.dumps(detail["kernels"]), file=sys.stderr, flush=True)
+        _emit_summary(detail, probe, error=_failed_error(detail))
     for name in names:
         remaining = deadline - (time.time() - t_start)
         if remaining < MIN_CONFIG_BUDGET_S:
@@ -699,6 +829,17 @@ def main() -> None:
         # Re-emit the FULL summary after every config (last stdout line
         # wins) so an outer kill at any moment leaves a parseable artifact.
         _emit_summary(detail, probe, error=_failed_error(detail))
+
+    if not args.configs:
+        # Quantization quality evidence — CPU child (deterministic tiny
+        # fixture), so it never competes with the TPU for budget; clipped
+        # to the deadline the module docstring promises to honor
+        remaining = deadline - (time.time() - t_start)
+        if remaining > 60:
+            detail["quality"] = _spawn(
+                "quality", min(300.0, remaining), env={"BENCH_PLATFORM": "cpu"}
+            )
+            print(json.dumps(detail["quality"]), file=sys.stderr, flush=True)
 
     # Final emit covers the nothing-ran / everything-skipped path too.
     _emit_summary(detail, probe, error=_failed_error(detail))

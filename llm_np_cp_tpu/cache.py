@@ -32,6 +32,17 @@ from jax import lax
 
 from llm_np_cp_tpu.config import ModelConfig
 
+CAPACITY_ALIGN = 128
+
+
+def align_capacity(n: int) -> int:
+    """Round a requested capacity up to the framework-wide 128 contract
+    (see KVCache.init docstring).  THE one definition — Generator,
+    SpeculativeGenerator, and bench.py all size through this, so the
+    contract can't silently diverge between production and measurement.
+    """
+    return -(-n // CAPACITY_ALIGN) * CAPACITY_ALIGN
+
 
 class KVCache(NamedTuple):
     k: jnp.ndarray  # [L, B, S_max, K, D]
@@ -52,6 +63,18 @@ class KVCache(NamedTuple):
         max_seq_len: int,
         dtype: jnp.dtype = jnp.bfloat16,
     ) -> "KVCache":
+        """Allocate zeroed slabs with capacity ``max_seq_len``.
+
+        Capacity contract: callers that derive capacity from request
+        shapes (Generator, SpeculativeGenerator) round it UP to a
+        multiple of 128 before calling — unused slots cost HBM but are
+        masked off by ``valid``/per-row lengths, while aligned capacities
+        keep the Pallas decode kernel's kv-block size near its requested
+        512 (an unaligned — worst case prime — capacity would shrink the
+        largest usable divisor toward 1) and make seq-axis sharding
+        divisibility automatic.  ``init`` itself honours the exact value
+        it is given so tests can build odd-capacity caches on purpose.
+        """
         shape = (
             config.num_hidden_layers,
             batch_size,

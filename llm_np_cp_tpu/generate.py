@@ -31,7 +31,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
-from llm_np_cp_tpu.cache import KVCache
+from llm_np_cp_tpu.cache import KVCache, align_capacity
 from llm_np_cp_tpu.config import ModelConfig
 from llm_np_cp_tpu.models.transformer import forward
 from llm_np_cp_tpu.ops.sampling import Sampler
@@ -161,6 +161,11 @@ def make_chunked_prefill_fn(
         tok = sampler(key, last)
         return tok, cache, last
 
+    # expose the jitted steps so AOT warmers compile the PROGRAM the
+    # measured path dispatches (bench.run_warm; a make_prefill_fn lowered
+    # at the chunk shape is a different program and misses the cache)
+    prefill_chunked.chunk_step = chunk_step
+    prefill_chunked.first_step = first_step
     return prefill_chunked
 
 
@@ -268,6 +273,16 @@ class Generator:
                 f"decode_attn_impl must be 'xla' or 'flash_decode', "
                 f"got {decode_attn_impl!r}"
             )
+        # Mosaic gate: a Pallas impl that fails to compile on the live
+        # backend downgrades to XLA with one warning instead of dying at
+        # first dispatch (ops/pallas/support.py; r3 postmortem).
+        from llm_np_cp_tpu.ops.pallas.support import gate_attn_impl
+
+        prefill_attn_impl = gate_attn_impl(prefill_attn_impl)
+        decode_attn_impl = gate_attn_impl(
+            decode_attn_impl,
+            int8_cache=jnp.dtype(cache_dtype) == jnp.int8,
+        )
         if prefill_chunk:
             self._prefill = make_chunked_prefill_fn(
                 config, self.sampler, prefill_chunk, prefill_attn_impl
@@ -281,7 +296,16 @@ class Generator:
         )
 
     def _init_cache(self, batch: int, max_seq_len: int) -> KVCache:
-        return KVCache.init(self.config, batch, max_seq_len, dtype=self.cache_dtype)
+        # Capacity is rounded UP to a multiple of 128: slots past the
+        # requested length are masked off (validity masks use per-row
+        # lengths, not capacity), decode_attention's kv-block search never
+        # collapses toward block_s=1 on a prime capacity, and seq-axis
+        # sharding divisibility is automatic.  Contract documented in
+        # cache.py.
+        return KVCache.init(
+            self.config, batch, align_capacity(max_seq_len),
+            dtype=self.cache_dtype,
+        )
 
     def _run_fused(
         self,

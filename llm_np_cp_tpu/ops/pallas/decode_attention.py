@@ -12,13 +12,22 @@ Design choices vs the prefill kernel:
   boolean mask the XLA path uses (cache validity ∧ causality ∧ sliding
   window ∧ ragged-batch pads), so every decode feature — including
   per-row lengths from batched speculative decoding — works unchanged.
-- the grouped query heads for one KV head ride along as a tiny [G, D]
-  block; decode is HBM-bound on the K/V stream, so MXU shape efficiency
-  is irrelevant — the win, if any, is fusion (no [B,H,S] score
-  materialization between HLOs).
+- grid is (batch, kv_blocks) and ALL kv heads are processed inside the
+  kernel per block (static unroll over K).  Mosaic requires the last two
+  block dims to be 8/128-aligned or equal to the full array dims; taking
+  the full (K, D) trailing dims of the native [B, S, K, D] slab satisfies
+  that with ZERO transposes or copies, and each cache block is streamed
+  through VMEM exactly once per step (the r3 layout with K in the grid
+  was rejected by Mosaic on hardware — block (1, block_s, 1, d) has an
+  unaligned second-minor dim of 1).
+- decode is HBM-bound on the K/V stream, so MXU shape efficiency of the
+  tiny [G, D] query blocks is irrelevant — the win is fusion (no
+  [B, H, S] score materialization between HLOs).
 
 Benchmark-gated like every kernel here (SURVEY §7 step 7): wired as
-``attn_impl="flash_decode"``, default stays XLA.
+``attn_impl="flash_decode"``, default stays XLA, and Generator probes
+Mosaic support once at construction, downgrading to XLA with a warning
+instead of dying at first dispatch (ops/pallas/support.py).
 """
 
 from __future__ import annotations
@@ -32,17 +41,23 @@ from jax.experimental.pallas import tpu as pltpu
 
 NEG_INF = float(jnp.finfo(jnp.float32).min)
 
+# VMEM working-set budget for the double-buffered K/V (+scale) blocks.
+# v5e VMEM is ~16 MiB/core; leave generous headroom for q/mask/scratch
+# and the compiler's own buffers.
+_VMEM_BUDGET_BYTES = 8 * 2**20
+
 
 def _decode_kernel(
     *refs, scale: float, softcap: float | None, quantized: bool,
+    kv_heads: int, group: int,
 ):
     if quantized:
         (q_ref, k_ref, v_ref, mask_ref, ks_ref, vs_ref,
          o_ref, m_ref, l_ref, acc_ref) = refs
     else:
         q_ref, k_ref, v_ref, mask_ref, o_ref, m_ref, l_ref, acc_ref = refs
-    j = pl.program_id(2)  # kv block (innermost: scratch accumulates per (b,kh))
-    nj = pl.num_programs(2)
+    j = pl.program_id(1)  # kv block (innermost: scratch accumulates per b)
+    nj = pl.num_programs(1)
 
     @pl.when(j == 0)
     def _init():
@@ -50,35 +65,42 @@ def _decode_kernel(
         l_ref[:] = jnp.zeros_like(l_ref)
         acc_ref[:] = jnp.zeros_like(acc_ref)
 
-    q = q_ref[0, 0].astype(jnp.float32)  # [G, D]
-    k = k_ref[0, :, 0].astype(jnp.float32)  # [block_s, D]
-    v = v_ref[0, :, 0].astype(jnp.float32)
-    if quantized:
-        # int8 cache: HBM streams 1-byte values; dequant happens here in
-        # VMEM (the XLA path fuses the same multiply into its einsum)
-        k = k * ks_ref[0, :, 0][:, None]
-        v = v * vs_ref[0, :, 0][:, None]
+    mask = mask_ref[0, :, 0]  # [block_s]
 
-    s = jax.lax.dot_general(
-        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-    ) * scale  # [G, block_s]
-    if softcap is not None:
-        s = jnp.tanh(s / softcap) * softcap
-    s = jnp.where(mask_ref[0][None, :], s, NEG_INF)
+    # Static unroll over kv heads: K is small (1-16) and each iteration is
+    # an independent [G, block_s] online-softmax update against the SAME
+    # VMEM-resident block — the slab is streamed from HBM once per step.
+    for ki in range(kv_heads):
+        q = q_ref[0, ki].astype(jnp.float32)  # [G, D]
+        k = k_ref[0, :, ki].astype(jnp.float32)  # [block_s, D]
+        v = v_ref[0, :, ki].astype(jnp.float32)
+        if quantized:
+            # int8 cache: HBM streams 1-byte values; dequant happens here
+            # in VMEM (the XLA path fuses the same multiply into its einsum)
+            k = k * ks_ref[0, :, ki][:, None]
+            v = v * vs_ref[0, :, ki][:, None]
 
-    m_prev = m_ref[:]  # [G, 1]
-    m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
-    p = jnp.exp(s - m_new)
-    # re-zero masked slots: exp(NEG_INF - m) underflows to 0 for any real
-    # m, but a FULLY-masked row has m == NEG_INF and would get p == 1
-    # everywhere, silently averaging V over garbage slots
-    p = jnp.where(mask_ref[0][None, :], p, 0.0)
-    alpha = jnp.exp(m_prev - m_new)
-    l_ref[:] = l_ref[:] * alpha + jnp.sum(p, axis=-1, keepdims=True)
-    acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
-        p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
-    )
-    m_ref[:] = m_new
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale  # [G, block_s]
+        if softcap is not None:
+            s = jnp.tanh(s / softcap) * softcap
+        s = jnp.where(mask[None, :], s, NEG_INF)
+
+        rows = slice(ki * group, (ki + 1) * group)
+        m_prev = m_ref[rows]  # [G, 1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        # re-zero masked slots: exp(NEG_INF - m) underflows to 0 for any
+        # real m, but a FULLY-masked row has m == NEG_INF and would get
+        # p == 1 everywhere, silently averaging V over garbage slots
+        p = jnp.where(mask[None, :], p, 0.0)
+        alpha = jnp.exp(m_prev - m_new)
+        l_ref[rows] = l_ref[rows] * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc_ref[rows] = acc_ref[rows] * alpha + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        m_ref[rows] = m_new
 
     @pl.when(j == nj - 1)
     def _finalize():
@@ -86,7 +108,44 @@ def _decode_kernel(
         # current token is always valid) has l == 0 thanks to the p
         # re-zeroing above; emit zeros instead of dividing by zero.
         l = jnp.where(l_ref[:] == 0.0, 1.0, l_ref[:])
-        o_ref[0, 0] = (acc_ref[:] / l).astype(o_ref.dtype)
+        o_ref[0] = (acc_ref[:] / l).reshape(o_ref.shape[1:]).astype(o_ref.dtype)
+
+
+def select_block_s(
+    s: int, kv_heads: int, head_dim: int, kv_itemsize: int,
+    requested: int, quantized: bool,
+) -> int:
+    """Largest kv-block length that divides ``s``, is 8-aligned (Mosaic
+    second-minor rule for the [B, S, 1] mask block), and keeps the
+    double-buffered K/V(+scale) working set inside the VMEM budget.
+
+    Falls back to a single whole-``s`` block for short unaligned caches
+    (then the block equals the full dim, which Mosaic also accepts).
+    Raises for caches that are both unaligned and too large — Generator
+    sizes caches to multiples of 128 (generate.py) so real callers never
+    hit that.
+    """
+    row_bytes = kv_heads * head_dim * kv_itemsize * 2  # K and V
+    if quantized:
+        row_bytes += kv_heads * 4 * 2  # f32 k/v scales
+    cap = max(8, (_VMEM_BUDGET_BYTES // (2 * row_bytes)) // 8 * 8)
+    best = 0
+    # start aligned DOWN to 8 — an unaligned start would step through
+    # exclusively unaligned candidates and miss every valid divisor
+    for cand in range(min(requested, cap, s) // 8 * 8, 7, -8):
+        if s % cand == 0:
+            best = cand
+            break
+    if best:
+        return best
+    # same double-buffering factor as the cap path above
+    if 2 * s * row_bytes <= _VMEM_BUDGET_BYTES:
+        return s  # single block; block dim == full dim satisfies Mosaic
+    raise ValueError(
+        f"decode_attention: cache length {s} has no 8-aligned divisor and "
+        f"is too large for a single VMEM block; size caches to a multiple "
+        f"of 8 (Generator rounds capacities to 128)"
+    )
 
 
 @functools.partial(
@@ -141,35 +200,32 @@ def decode_attention(
 
     # ZERO-COPY contract: decode is HBM-bound on streaming the cache slab,
     # so the kernel reads K/V in their NATIVE [B, S, K, D] layout via 4-D
-    # BlockSpecs — no transpose/pad materialization of the slabs (an early
-    # version transposed both, doubling the very traffic the kernel exists
-    # to avoid).  q's head split [B,1,H,D]→[B,1,K,G,D] is a free reshape.
+    # BlockSpecs whose trailing (K, D) dims are the FULL array dims — no
+    # transpose/pad materialization of the slabs, and Mosaic's trailing-
+    # dims alignment rule is satisfied for any K/D.  q's head split
+    # [B,1,H,D]→[B,K,G,D] is a free reshape.
     qf = q.reshape(b, kh, g, d)  # [B, K, G, D]
+    mask3 = mask[:, :, None]  # [B, S, 1]: trailing dims (block_s, 1)
 
-    # block_s must divide s (padding k/v would copy the whole slab; Mosaic
-    # edge-padding reads undefined bytes that 0*NaN could leak through).
-    # Callers size caches to 8-aligned capacities, so the largest divisor
-    # ≤ block_s is near block_s in practice; worst case degrades to more
-    # grid steps, never to wrong results.
-    block_s = min(block_s, max(s, 1))
-    while s % block_s:
-        block_s -= 1
+    block_s = select_block_s(
+        s, kh, d, jnp.dtype(k.dtype).itemsize, block_s, quantized
+    )
 
-    grid = (b, kh, s // block_s)
+    grid = (b, s // block_s)
     in_specs = [
-        pl.BlockSpec((1, 1, g, d), lambda bi, ki, j: (bi, ki, 0, 0),
+        pl.BlockSpec((1, kh, g, d), lambda bi, j: (bi, 0, 0, 0),
                      memory_space=pltpu.VMEM),
-        pl.BlockSpec((1, block_s, 1, d), lambda bi, ki, j: (bi, j, ki, 0),
+        pl.BlockSpec((1, block_s, kh, d), lambda bi, j: (bi, j, 0, 0),
                      memory_space=pltpu.VMEM),
-        pl.BlockSpec((1, block_s, 1, d), lambda bi, ki, j: (bi, j, ki, 0),
+        pl.BlockSpec((1, block_s, kh, d), lambda bi, j: (bi, j, 0, 0),
                      memory_space=pltpu.VMEM),
-        pl.BlockSpec((1, block_s), lambda bi, ki, j: (bi, j),
+        pl.BlockSpec((1, block_s, 1), lambda bi, j: (bi, j, 0),
                      memory_space=pltpu.VMEM),
     ]
-    operands = [qf, k, v, mask]
+    operands = [qf, k, v, mask3]
     if quantized:
         scale_spec = pl.BlockSpec(
-            (1, block_s, 1), lambda bi, ki, j: (bi, j, ki),
+            (1, block_s, kh), lambda bi, j: (bi, j, 0),
             memory_space=pltpu.VMEM,
         )
         in_specs += [scale_spec, scale_spec]
@@ -177,17 +233,17 @@ def decode_attention(
     out = pl.pallas_call(
         functools.partial(
             _decode_kernel, scale=scale, softcap=logit_softcap,
-            quantized=quantized,
+            quantized=quantized, kv_heads=kh, group=g,
         ),
         out_shape=jax.ShapeDtypeStruct((b, kh, g, d), out_dtype),
         grid=grid,
         in_specs=in_specs,
-        out_specs=pl.BlockSpec((1, 1, g, d), lambda bi, ki, j: (bi, ki, 0, 0),
+        out_specs=pl.BlockSpec((1, kh, g, d), lambda bi, j: (bi, 0, 0, 0),
                                memory_space=pltpu.VMEM),
         scratch_shapes=[
-            pltpu.VMEM((g, 1), jnp.float32),
-            pltpu.VMEM((g, 1), jnp.float32),
-            pltpu.VMEM((g, d), jnp.float32),
+            pltpu.VMEM((h, 1), jnp.float32),
+            pltpu.VMEM((h, 1), jnp.float32),
+            pltpu.VMEM((h, d), jnp.float32),
         ],
         interpret=interpret,
     )(*operands)

@@ -36,7 +36,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
-from llm_np_cp_tpu.cache import KVCache, truncate
+from llm_np_cp_tpu.cache import KVCache, align_capacity, truncate
 from llm_np_cp_tpu.config import ModelConfig
 from llm_np_cp_tpu.generate import _check_capacity, make_prefill_fn
 from llm_np_cp_tpu.models.transformer import forward
@@ -373,6 +373,10 @@ class SpeculativeGenerator:
         # rounds overshoot by up to γ+1 tokens before rollback trims them
         max_seq_len = max_seq_len or s + max_new_tokens + self.gamma + 1
         _check_capacity(s, max_new_tokens + self.gamma + 1, max_seq_len)
+        # 128-aligned capacities (same contract as Generator._init_cache):
+        # extra slots are masked off, and the Pallas decode kernel's
+        # kv-block search stays near its requested size.
+        max_seq_len = align_capacity(max_seq_len)
 
         key = jax.random.PRNGKey(seed)
         key, kp = jax.random.split(key)
