@@ -786,10 +786,13 @@ def main() -> None:
 
     if not probe.get("ok"):
         # TPU never answered: still produce the backend-independent
-        # quality evidence on CPU, then emit the probe-coverage artifact.
-        detail["quality"] = _spawn(
-            "quality", reserve_s, env={"BENCH_PLATFORM": "cpu"}
-        )
+        # quality evidence on CPU, then emit the probe-coverage artifact
+        # (clipped to the deadline, same as the success path).
+        remaining = deadline - (time.time() - t_start)
+        if remaining > 60:
+            detail["quality"] = _spawn(
+                "quality", min(reserve_s, remaining), env={"BENCH_PLATFORM": "cpu"}
+            )
         _emit_summary(
             detail, probe,
             error=f"TPU backend unreachable: {probe.get('error')}",

@@ -1,0 +1,43 @@
+"""Real-checkpoint smoke (VERDICT r3 task 8, stretch).
+
+Every HF-parity test in this suite runs on tiny random checkpoints
+written in exact HF layout; this is the one test that exercises the
+loader against an ACTUAL published checkpoint — the reference's default
+model (meta-llama/Llama-3.2-3B at /root/reference/llama3.2_model.py:1102;
+we use the 1B sibling to bound download size).  The build environment
+has zero egress, so the test probes connectivity first and skips
+cleanly offline — a skipped-or-passed marker, never a false failure.
+"""
+
+import socket
+
+import pytest
+
+
+def _online(host: str = "huggingface.co", timeout: float = 3.0) -> bool:
+    try:
+        socket.getaddrinfo(host, 443)
+        return True
+    except OSError:
+        return False
+
+
+@pytest.mark.skipif(not _online(), reason="no network egress to huggingface.co")
+def test_load_and_greedy_decode_real_checkpoint(tmp_path):
+    import jax.numpy as jnp
+    import numpy as np
+
+    from llm_np_cp_tpu.generate import Generator
+    from llm_np_cp_tpu.ops.sampling import Sampler
+    from llm_np_cp_tpu.utils.loading import load_model
+
+    tok, params, config = load_model("meta-llama/Llama-3.2-1B", dtype=jnp.bfloat16)
+    gen = Generator(
+        params, config, sampler=Sampler(kind="greedy"),
+        stop_tokens=(tok.eos_token_id,) if tok.eos_token_id else (),
+    )
+    ids = tok("The capital of France is", return_tensors="np")["input_ids"]
+    res = gen.generate(ids.astype(np.int32), max_new_tokens=20, seed=0)
+    text = tok.decode(np.asarray(res.tokens)[0], skip_special_tokens=True)
+    assert res.num_generated > 0
+    assert "Paris" in text  # greedy Llama-3.2-1B answers this reliably
