@@ -99,6 +99,11 @@ DECODE_CONFIGS = {
         model="llama3b", batch=8, prompt_len=2048, decode_tokens=64,
         sampler="top_p", cache_dtype="int8",
     ),
+    # headline shape with the layer scan unrolled 2x (weight-stream
+    # software pipelining experiment; promoted to default only if it wins)
+    "llama1b_bs8_unroll2": dict(model="llama1b", batch=8, prompt_len=128,
+                                decode_tokens=256,
+                                env={"LLMTPU_SCAN_UNROLL": "2"}),
     # not in the default matrix: offline smoke test of the measurement path
     "smoke_tiny": dict(model="tiny", batch=2, prompt_len=16, decode_tokens=8),
 }
@@ -130,6 +135,7 @@ PRIORITY = [
     "prefill8k_flash",
     "prefill8k_xla",
     "llama1b_bs32",
+    "llama1b_bs8_unroll2",  # layer-scan unroll experiment vs bs8
     "llama1b_bs8_fdec",   # Pallas decode-attention experiment vs bs8
     "llama1b_bs8_fdec_kvq8",  # Pallas kernel reading the int8 KV cache
     "llama3b_seq2048_bs8",  # 3B params: the most expensive, last
@@ -500,6 +506,13 @@ def run_warm() -> dict:
             )
         )
         ids = jax.ShapeDtypeStruct((batch, prompt_len), jnp.int32)
+        # per-config env (e.g. LLMTPU_SCAN_UNROLL) is read at TRACE time,
+        # so it must be live while lowering or this warms the wrong
+        # program and the measured child compiles cold
+        saved_env = {
+            k: os.environ.get(k) for k in (spec.get("env") or {})
+        }
+        os.environ.update(spec.get("env") or {})
         try:
             chunk = spec.get("chunk")
             if chunk:
@@ -533,6 +546,12 @@ def run_warm() -> dict:
         except Exception as e:  # record and keep warming the rest
             failed.append({"config": name, "error": repr(e)[:300]})
             _phase("warm", f"{name}:FAILED", t0)
+        finally:
+            for k, v in saved_env.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
     return {
         "config": "warm",
         "ok": not failed,
@@ -826,7 +845,10 @@ def main() -> None:
             print(json.dumps(detail[name]), file=sys.stderr, flush=True)
             continue
         budget = min(TIMEOUTS.get(name, DEFAULT_TIMEOUT), remaining - 10)
-        res = _spawn(name, budget)
+        spec_env = {
+            **DECODE_CONFIGS, **PREFILL_CONFIGS, **SPEC_CONFIGS
+        }.get(name, {}).get("env")
+        res = _spawn(name, budget, env=spec_env)
         detail[name] = res
         print(json.dumps(res), file=sys.stderr, flush=True)
         # Re-emit the FULL summary after every config (last stdout line

@@ -101,6 +101,11 @@ def run(argv: list[str] | None = None, default_model: str = "meta-llama/Llama-3.
             "are mutually exclusive: chunked prefill requires dense "
             "same-length rows"
         )
+    if args.prompts_file and (args.attn_impl in ("flash", "ring") or args.flash_prefill):
+        raise SystemExit(
+            "--prompts-file uses ragged pad masks, which the flash/ring "
+            "prefill kernels do not consume; use the default --attn-impl xla"
+        )
     if args.backend == "numpy":
         if args.quantize != "none":
             raise SystemExit("--quantize applies to the tpu backend only "

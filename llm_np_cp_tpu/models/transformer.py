@@ -27,6 +27,7 @@ Architecture (TPU-first, not a translation):
 from __future__ import annotations
 
 import math
+import os
 from typing import Any
 
 import jax
@@ -534,8 +535,20 @@ def forward(
             ys += (attn_weights,)
         return x, ys
 
+    # LLMTPU_SCAN_UNROLL=N (trace-time): unroll the layer scan so the
+    # compiler can software-pipeline the per-layer weight stream across
+    # layer boundaries — decode is bound by that stream.  Default 1; the
+    # bench A/Bs it (llama1b_bs8_unroll2) before it could ever become a
+    # default.  Ignored when it doesn't divide the layer count.
+    try:
+        unroll = int(os.environ.get("LLMTPU_SCAN_UNROLL", "1").strip())
+    except ValueError:
+        unroll = 1  # malformed values degrade like non-divisors do
+    if unroll < 1 or config.num_hidden_layers % unroll:
+        unroll = 1
     x, scan_out = lax.scan(
-        layer_step, x, (lp, k_cache, v_cache, ks_cache, vs_cache, is_sliding)
+        layer_step, x, (lp, k_cache, v_cache, ks_cache, vs_cache, is_sliding),
+        unroll=unroll,
     )
     new_k, new_v = scan_out[0], scan_out[1]
     new_ks, new_vs = scan_out[2], scan_out[3]

@@ -15,8 +15,11 @@ import pytest
 
 
 def _online(host: str = "huggingface.co", timeout: float = 3.0) -> bool:
+    # a real bounded TCP connect — DNS alone both ignores `timeout`
+    # (getaddrinfo has none) and false-positives behind resolvers that
+    # answer names while egress is blocked
     try:
-        socket.getaddrinfo(host, 443)
+        socket.create_connection((host, 443), timeout=timeout).close()
         return True
     except OSError:
         return False
