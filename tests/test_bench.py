@@ -95,3 +95,30 @@ def test_warm_smoke_offline():
     assert res.get("ok") is True, res
     assert set(res["warmed"]) == {n for n in bench.PRIORITY
                                  if n not in bench.SPEC_CONFIGS}
+
+
+def test_emit_summary_surfaces_prior_live_capture(capsys, tmp_path, monkeypatch):
+    """A tunnel-down run keeps value=0.0 (the numeric fields are THIS
+    run's measurement) but carries the round's saved live capture in
+    detail, trimmed and labeled."""
+    (tmp_path / "BENCH_TPU_LIVE_r4.json").write_text(json.dumps({
+        "value": 1629.3, "vs_baseline": 1.629,
+        "detail": {"headline_definition": "llama1b_bs8_aggregate: ..."},
+    }))
+    monkeypatch.setattr(bench, "REPO", str(tmp_path))
+    bench._emit_summary({}, {"ok": False, "error": "down"}, error="TPU unreachable")
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["value"] == 0.0  # never a number this run didn't measure
+    assert "prior_capture" in out["detail"]
+    assert out["detail"]["prior_capture"]["value"] == 1629.3
+    assert "detail" not in out["detail"]["prior_capture"]  # trimmed
+    assert "NO MEASUREMENT THIS RUN" in out["detail"]["headline_definition"]
+    assert out["error"]
+
+
+def test_emit_summary_no_prior_capture(capsys, tmp_path, monkeypatch):
+    monkeypatch.setattr(bench, "REPO", str(tmp_path))
+    bench._emit_summary({}, {"ok": False, "error": "down"}, error="TPU unreachable")
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["value"] == 0.0
+    assert "prior_capture" not in out["detail"]
