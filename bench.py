@@ -724,10 +724,16 @@ def _load_prior_capture() -> dict | None:
     run that measured nothing; those fields are this run's measurement
     contract.  Trimmed to the headline fields (no nested detail)."""
     import glob
+    import re
+
+    def _round_no(path: str) -> int:
+        # numeric round suffix, not mtime (git checkouts flatten mtimes)
+        # and not lexicographic (r10 would sort before r4)
+        m = re.search(r"_r(\d+)\.json$", path)
+        return int(m.group(1)) if m else -1
 
     files = sorted(
-        glob.glob(os.path.join(REPO, "BENCH_TPU_LIVE_*.json")),
-        key=os.path.getmtime,  # not lexicographic: r10 sorts before r4
+        glob.glob(os.path.join(REPO, "BENCH_TPU_LIVE_*.json")), key=_round_no
     )
     for path in reversed(files):
         try:

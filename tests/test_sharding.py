@@ -169,3 +169,13 @@ def test_plan_validation():
         MeshPlan(model=8).validate(cfg)
     with pytest.raises(ValueError, match="devices"):
         make_mesh(MeshPlan(data=4, model=4))
+
+
+def test_baseline_configs_aot_compile():
+    """BASELINE.md configs 4 (gemma2-9b bs=32 TP=8) and 5 (llama3.1-8b
+    seq=8192 SP×TP) AOT-compile from abstract arrays on the 8-device
+    mesh — the v5e-8 shapes this environment cannot execute still get
+    structural compile evidence at real dimensions (__graft_entry__)."""
+    import __graft_entry__ as graft
+
+    graft._aot_baseline_configs()
