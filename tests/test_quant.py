@@ -240,3 +240,24 @@ def test_speculative_with_int4_target():
         cache_dtype=jnp.float32,
     ).generate(prompt, 10).tokens
     np.testing.assert_array_equal(want, got)
+
+
+def test_int4_einsum_moe_specs_match_dequantized():
+    """The pair-contraction int4 path (_einsum4) on the stacked-expert
+    MoE specs — every quant_einsum spec in the repo with a 4-D weight."""
+    from llm_np_cp_tpu.quant import quant_einsum, quantize_array4
+
+    rng = np.random.default_rng(5)
+    x = jnp.asarray(rng.normal(size=(2, 3, 4, 16)), jnp.float32)  # [g,e,c,h]
+    w = jnp.asarray(rng.normal(size=(3, 16, 10)) * 0.2, jnp.float32)  # [e,h,i]
+    qw = quantize_array4(w, axis=-2)
+    want = jnp.einsum("gech,ehi->geci", x, dequantize(qw))
+    got = quant_einsum("gech,ehi->geci", x, qw)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-5)
+
+    xd = jnp.asarray(rng.normal(size=(2, 3, 4, 10)), jnp.float32)  # [g,e,c,i]
+    wd = jnp.asarray(rng.normal(size=(3, 10, 16)) * 0.2, jnp.float32)  # [e,i,h]
+    qwd = quantize_array4(wd, axis=-2)
+    want = jnp.einsum("geci,eih->gech", xd, dequantize(qwd))
+    got = quant_einsum("geci,eih->gech", xd, qwd)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-5)
