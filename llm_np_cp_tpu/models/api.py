@@ -83,3 +83,24 @@ class CausalLM:
         if self.config.tie_word_embeddings:
             return self.params["embed_tokens"]
         return self.params["lm_head"]
+
+    def set_output_embeddings(self, value: jnp.ndarray) -> None:
+        """llama3.2_model.py:757-758; a tied model's head IS the embedding
+        table, so setting one sets both (the reference, which materializes
+        the tied head as a second attribute, silently un-ties here)."""
+        if self.config.tie_word_embeddings:
+            self.params["embed_tokens"] = value
+        else:
+            self.params["lm_head"] = value
+
+    def get_decoder(self) -> dict[str, Any]:
+        """The backbone params (everything but the head) — the functional
+        analogue of the reference's ``self.model`` (llama3.2_model.py:765-766)."""
+        return {k: v for k, v in self.params.items() if k != "lm_head"}
+
+    def set_decoder(self, decoder: dict[str, Any]) -> None:
+        """llama3.2_model.py:761-762: swap the backbone, keep the head."""
+        head = self.params.get("lm_head")
+        self.params = dict(decoder)
+        if head is not None and "lm_head" not in self.params:
+            self.params["lm_head"] = head

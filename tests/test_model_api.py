@@ -90,3 +90,28 @@ def test_output_attentions_rejects_flash():
     except ValueError:
         raised = True
     assert raised
+
+
+def test_hf_accessor_surface():
+    """The reference's full accessor set (llama3.2_model.py:744-766) on
+    the functional facade: embeddings get/set, decoder get/set."""
+    cfg, params = _model()
+    m = CausalLM(params, cfg)
+
+    emb = m.get_input_embeddings()
+    assert emb.shape == (cfg.vocab_size, cfg.hidden_size)
+    m.set_input_embeddings(emb * 2)
+    np.testing.assert_allclose(
+        np.asarray(m.get_input_embeddings()), np.asarray(emb) * 2
+    )
+
+    out = m.get_output_embeddings()
+    if cfg.tie_word_embeddings:
+        assert out is m.get_input_embeddings()
+    m.set_output_embeddings(out)
+
+    dec = m.get_decoder()
+    assert "lm_head" not in dec and "layers" in dec
+    m.set_decoder(dec)  # round-trip keeps the model callable
+    logits = m(jnp.asarray(np.arange(1, 6)[None, :], jnp.int32))[1]
+    assert logits.shape == (1, 5, cfg.vocab_size)
