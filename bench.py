@@ -63,8 +63,10 @@ artifact IS the baseline.)
 from __future__ import annotations
 
 import argparse
+import glob
 import json
 import os
+import re
 import subprocess
 import sys
 import time
@@ -775,9 +777,6 @@ def _load_prior_capture() -> dict | None:
     in ``detail`` ONLY — the top-level value/vs_baseline stay 0.0 for a
     run that measured nothing; those fields are this run's measurement
     contract.  Trimmed to the headline fields (no nested detail)."""
-    import glob
-    import re
-
     def _round_no(path: str) -> int:
         # numeric round suffix, not mtime (git checkouts flatten mtimes)
         # and not lexicographic (r10 would sort before r4)
