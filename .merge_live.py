@@ -1,0 +1,56 @@
+"""Merge newly captured bench rows into BENCH_TPU_LIVE_r4.json.
+
+Usage: python .merge_live.py /tmp/bench_retry_r4.out [/tmp/kernels_r4.out]
+Takes the LAST parseable summary line of each input; config rows with
+ok=true replace/add into the live artifact's detail; headline value is
+recomputed from llama1b_bs8 if present. Scratch tool for the r4 session,
+not part of the framework.
+"""
+
+import json
+import sys
+
+LIVE = "BENCH_TPU_LIVE_r4.json"
+
+
+def last_json(path):
+    out = None
+    with open(path) as f:
+        for line in f:
+            try:
+                out = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+    return out
+
+
+def main():
+    with open(LIVE) as f:
+        live = json.load(f)
+    merged = []
+    for path in sys.argv[1:]:
+        new = last_json(path)
+        if new is None:
+            print(f"{path}: no parseable JSON line, skipped")
+            continue
+        if "detail" in new:  # a summary line: merge its ok config rows
+            for name, row in new["detail"].items():
+                if isinstance(row, dict) and row.get("ok"):
+                    live["detail"][name] = row
+                    merged.append(name)
+        elif new.get("config") == "kernels":  # a raw kernels child line
+            live["detail"]["kernels"] = new
+            merged.append("kernels")
+    bs8 = live["detail"].get("llama1b_bs8", {})
+    if bs8.get("decode_tok_s_chip"):
+        live["value"] = bs8["decode_tok_s_chip"]
+        live["vs_baseline"] = round(live["value"] / 1000.0, 3)
+    with open(LIVE, "w") as f:
+        json.dump(live, f)
+        f.write("\n")
+    print("merged:", merged)
+    print("headline:", live["value"])
+
+
+if __name__ == "__main__":
+    main()
