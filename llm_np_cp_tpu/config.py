@@ -81,6 +81,16 @@ class ModelConfig:
     # schedule, config key `cache_implementation: hybrid`, gemma2_model.py:104).
     query_pre_attn_scalar: float | None = None
 
+    # --- Layer-scan unroll (performance knob, no numeric effect): unroll
+    # the lax.scan over layers so XLA can software-pipeline the per-layer
+    # weight stream across layer boundaries.  Part of the config — and so
+    # of every jit cache key a config closes over — because an env-var
+    # read at trace time silently pins the first-seen value (ADVICE r4).
+    # The LLMTPU_SCAN_UNROLL env var still overrides it at TRACE time for
+    # bench A/Bs; library users should set this field instead.  Values
+    # that don't divide num_hidden_layers degrade to 1.
+    scan_unroll: int = 1
+
     # --- Mixture-of-Experts (framework extension; neither reference family
     # is MoE — SURVEY §2.9 lists EP as N/A — but the framework supports
     # Mixtral-style sparse MLPs so expert parallelism has a real workload).

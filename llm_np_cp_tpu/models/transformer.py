@@ -112,31 +112,55 @@ def param_shapes(config: ModelConfig) -> dict[str, Any]:
     return spec
 
 
+# jitted init program per (config, dtype) — see init_params
+_INIT_PROGRAMS: dict = {}
+
+
 def init_params(
     rng: jax.Array, config: ModelConfig, dtype: jnp.dtype = jnp.bfloat16
 ) -> Params:
-    """Random small-scale init (for tests and synthetic benchmarks)."""
+    """Random small-scale init (for tests and synthetic benchmarks).
+
+    The whole init runs as ONE jitted program: eager per-leaf
+    ``jax.random.normal`` costs a device dispatch per leaf plus an f32
+    intermediate materialization each — at 3B scale over a tunneled chip
+    that is minutes of round-trips (the r1–r4 benches never got a 3B
+    number; the breadcrumbs pointed at params build).  Under jit the init
+    is a single dispatch and every leaf materializes on-device in its
+    final dtype.
+    """
     spec = param_shapes(config)
     paths_leaves, treedef = jax.tree_util.tree_flatten_with_path(
         spec, is_leaf=lambda x: isinstance(x, tuple)
     )
-    keys = jax.random.split(rng, len(paths_leaves))
+    # cache the jitted program per (config, dtype) — a fresh closure per
+    # call would re-trace and recompile the identical init every time
+    # (the test suite calls init_params hundreds of times)
+    cache_key = (config, jnp.dtype(dtype).name)
+    _init = _INIT_PROGRAMS.get(cache_key)
+    if _init is None:
 
-    def make(key: jax.Array, path: tuple, shape: tuple[int, ...]) -> jnp.ndarray:
-        name = path[-1].key  # leaf name in the dict pytree
-        if name.startswith("ln_") or name == "final_norm":
-            # norm gammas: zeros under unit-offset (so 1+w == 1), ones otherwise
-            init = 0.0 if config.rms_norm_unit_offset else 1.0
-            return jnp.full(shape, init, dtype=dtype)
-        if name.endswith("_bias"):
-            # biases start small-but-nonzero so tests exercise the add path
-            return (jax.random.normal(key, shape, jnp.float32) * 0.02).astype(dtype)
-        scale = 0.02
-        return (jax.random.normal(key, shape, dtype=jnp.float32) * scale).astype(dtype)
+        @jax.jit
+        def _init(rng: jax.Array) -> list[jnp.ndarray]:
+            keys = jax.random.split(rng, len(paths_leaves))
 
-    return jax.tree.unflatten(
-        treedef, [make(k, p, s) for k, (p, s) in zip(keys, paths_leaves)]
-    )
+            def make(key: jax.Array, path: tuple, shape: tuple[int, ...]) -> jnp.ndarray:
+                name = path[-1].key  # leaf name in the dict pytree
+                if name.startswith("ln_") or name == "final_norm":
+                    # norm gammas: zeros under unit-offset (so 1+w == 1), ones otherwise
+                    init = 0.0 if config.rms_norm_unit_offset else 1.0
+                    return jnp.full(shape, init, dtype=dtype)
+                if name.endswith("_bias"):
+                    # biases start small-but-nonzero so tests exercise the add path
+                    return (jax.random.normal(key, shape, jnp.float32) * 0.02).astype(dtype)
+                scale = 0.02
+                return (jax.random.normal(key, shape, dtype=jnp.float32) * scale).astype(dtype)
+
+            return [make(k, p, s) for k, (p, s) in zip(keys, paths_leaves)]
+
+        _INIT_PROGRAMS[cache_key] = _init
+
+    return jax.tree.unflatten(treedef, _init(rng))
 
 
 # ----------------------------------------------------------------------
@@ -535,13 +559,17 @@ def forward(
             ys += (attn_weights,)
         return x, ys
 
-    # LLMTPU_SCAN_UNROLL=N (trace-time): unroll the layer scan so the
-    # compiler can software-pipeline the per-layer weight stream across
-    # layer boundaries — decode is bound by that stream.  Default 1; the
-    # bench A/Bs it (llama1b_bs8_unroll2) before it could ever become a
-    # default.  Ignored when it doesn't divide the layer count.
+    # Unroll the layer scan so the compiler can software-pipeline the
+    # per-layer weight stream across layer boundaries — decode is bound
+    # by that stream.  config.scan_unroll is the API (part of every jit
+    # cache key the config closes over); LLMTPU_SCAN_UNROLL overrides it
+    # at TRACE time only — an env change after a fn's first trace does
+    # nothing for that fn (the bench A/Bs via the env var in fresh
+    # subprocesses).  Non-divisors and malformed values degrade to 1.
     try:
-        unroll = int(os.environ.get("LLMTPU_SCAN_UNROLL", "1").strip())
+        unroll = int(
+            os.environ.get("LLMTPU_SCAN_UNROLL", str(config.scan_unroll)).strip()
+        )
     except ValueError:
         unroll = 1  # malformed values degrade like non-divisors do
     if unroll < 1 or config.num_hidden_layers % unroll:

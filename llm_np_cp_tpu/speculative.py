@@ -56,6 +56,42 @@ class SpecResult:
     tokens_per_round: float  # mean per active row
 
 
+def truncated_draft(
+    params: Params,
+    config: ModelConfig,
+    num_layers: int,
+    *,
+    bits: int | None = None,
+) -> tuple[Params, ModelConfig]:
+    """Layer-skip self-draft: the first ``num_layers`` decoder layers of
+    the target plus its embedding / final norm / head, optionally
+    quantized to ``bits``.
+
+    No second checkpoint needed (the draft IS a prefix of the target, so
+    vocab/tokenizer match by construction) and the draft's weight stream
+    shrinks with the layer count — at 8/16 layers + int4 the draft step
+    streams ~1/6 of the bf16 target.  Draft quality is what it is (the
+    early layers were never trained to feed the head directly); the
+    accept/resample rule keeps the OUTPUT distribution exactly the
+    target's regardless, so a weak draft costs speed only, never
+    correctness.  (Framework extension — the reference has no
+    speculation at all, llama3.2_model.py:865-902.)
+    """
+    if not 0 < num_layers <= config.num_hidden_layers:
+        raise ValueError(
+            f"num_layers must be in 1..{config.num_hidden_layers}, got {num_layers}"
+        )
+    draft = dict(params)
+    # stacked [L, ...] leaves: keep the first num_layers of each
+    draft["layers"] = jax.tree.map(lambda x: x[:num_layers], params["layers"])
+    draft_config = dataclasses.replace(config, num_hidden_layers=num_layers)
+    if bits is not None:
+        from llm_np_cp_tpu.quant import quantize_params
+
+        draft = quantize_params(draft, bits=bits)
+    return draft, draft_config
+
+
 def _as_rows(length: jnp.ndarray, batch: int) -> jnp.ndarray:
     """Cache length as per-row [B] (broadcasting a scalar on first use)."""
     length = jnp.asarray(length, jnp.int32)

@@ -85,7 +85,7 @@ def test_priority_matches_config_dicts():
         + list(bench.PREFILL_CONFIGS)
         if not n.startswith("smoke")
     }
-    assert set(bench.PRIORITY) == non_smoke
+    assert set(bench.PRIORITY) == non_smoke | bench.EXTRA_CHILDREN
 
 
 def test_warm_smoke_offline():
@@ -94,7 +94,28 @@ def test_warm_smoke_offline():
     res = bench._spawn("warm", 600)
     assert res.get("ok") is True, res
     assert set(res["warmed"]) == {n for n in bench.PRIORITY
-                                 if n not in bench.SPEC_CONFIGS}
+                                 if n not in bench.SPEC_CONFIGS
+                                 and n not in bench.EXTRA_CHILDREN}
+
+
+def test_decomp_smoke_offline():
+    """The decomp diagnostic child (fixed-vs-per-layer split) runs
+    end-to-end on CPU with the tiny model: rate sources are recorded, and
+    the per-layer/fixed split only appears when both depths were
+    transport-cancelled (never from mixed marginal/e2e rates)."""
+    res = bench._spawn(
+        "decomp", 600,
+        env={"BENCH_PLATFORM": "cpu", "DECOMP_MODEL": "tiny"},
+    )
+    assert res.get("ok") is True, res
+    for mode in ("bf16", "int8"):
+        block = res[mode]
+        assert block["step_ms"] > 0
+        assert set(block["rate_sources"]) <= {"marginal", "e2e"}
+        if block["rate_sources"] != ["marginal", "marginal"]:
+            assert "per_layer_ms" not in block
+            assert "skipped" in block["decomposition"]
+    assert "lm_head_ms" in res
 
 
 def test_emit_summary_surfaces_prior_live_capture(capsys, tmp_path, monkeypatch):

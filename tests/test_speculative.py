@@ -80,6 +80,52 @@ def test_greedy_spec_quantized_self_draft():
     assert res.acceptance_rate > 0.5
 
 
+def test_greedy_spec_truncated_draft_lossless():
+    """Layer-skip self-draft (truncated_draft): greedy output must stay
+    byte-identical to plain greedy decode, for any truncation depth and
+    with the draft quantized to int4."""
+    from llm_np_cp_tpu.speculative import truncated_draft
+
+    target = _params(6)
+    prompt = _prompt(6)
+    plain = Generator(target, CFG, sampler=Sampler(kind="greedy"),
+                      cache_dtype=jnp.float32)
+    want = plain.generate(prompt, 16).tokens[0]
+    for n_layers, bits in ((1, 4), (CFG.num_hidden_layers, None)):
+        dp, dc = truncated_draft(target, CFG, n_layers, bits=bits)
+        assert dc.num_hidden_layers == n_layers
+        spec = SpeculativeGenerator(
+            target, CFG, draft_params=dp, draft_config=dc, gamma=3,
+            sampler=Sampler(kind="greedy"), cache_dtype=jnp.float32,
+        )
+        res = spec.generate(prompt, 16)
+        np.testing.assert_array_equal(res.tokens, np.asarray(want))
+
+
+def test_truncated_draft_validates_layer_count():
+    from llm_np_cp_tpu.speculative import truncated_draft
+
+    target = _params(0)
+    with pytest.raises(ValueError):
+        truncated_draft(target, CFG, 0)
+    with pytest.raises(ValueError):
+        truncated_draft(target, CFG, CFG.num_hidden_layers + 1)
+
+
+def test_truncated_draft_param_prefix():
+    """The draft's stacked layer leaves are exactly the first-k slices of
+    the target's, and non-layer leaves are shared (no copy)."""
+    from llm_np_cp_tpu.speculative import truncated_draft
+
+    target = _params(1)
+    dp, dc = truncated_draft(target, CFG, 2)
+    for key, leaf in dp["layers"].items():
+        np.testing.assert_array_equal(
+            np.asarray(leaf), np.asarray(target["layers"][key][:2])
+        )
+    assert dp["embed_tokens"] is target["embed_tokens"]
+
+
 def test_sampled_spec_with_perfect_draft_accepts_everything():
     """With draft == target, p == q so min(1, p/q) == 1: acceptance must
     be exact regardless of sampler kind."""
