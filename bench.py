@@ -121,6 +121,21 @@ PREFILL_CONFIGS = {
     "prefill8k_chunked": dict(model="llama1b", prompt_len=8192, attn_impl="xla",
                               chunk=1024),
 }
+# Ragged-batch decode: prompts of very different lengths, LEFT-padded
+# (generate.generate_ragged).  The XLA path streams the full [B, S_cap]
+# cache slab every step regardless of validity; the Pallas decode kernel
+# skips each row's invisible blocks (leading pads + tail), so this is the
+# workload where the kernel has a structural edge — the win-case evidence
+# VERDICT r4 task 2 asks for, on a shape real serving actually has.
+RAGGED_CONFIGS = {
+    "ragged_bs8_xla": dict(model="llama1b", attn="xla"),
+    "ragged_bs8_fdec": dict(model="llama1b", attn="flash_decode"),
+    "smoke_ragged": dict(model="tiny", attn="xla", lens=(24, 16, 9, 4),
+                         decode=8),
+}
+RAGGED_LENS = (2048, 1536, 1024, 768, 512, 384, 256, 128)
+RAGGED_DECODE = 64
+
 SPEC_CONFIGS = {
     # batched self-speculation: bf16 target + int8 self-draft, γ=4
     "int8_spec_bs8": dict(model="llama1b", batch=8, prompt_len=128,
@@ -152,6 +167,8 @@ PRIORITY = [
     "int4_bs8",           # r4 fused-nibble einsum fix — never re-measured
     "llama1b_bs8_fdec_kvq8",  # kernel's best shot (VERDICT task 2) — never measured
     "llama1b_bs8_fdec",   # rewritten decode kernel at the headline shape
+    "ragged_bs8_xla",     # ragged decode: the kernel's structural win case
+    "ragged_bs8_fdec",
     "gemma2_2b_bs8",      # Gemma north-star number (VERDICT task 3)
     "int8_bs8",           # roofline-gap anchor (VERDICT task 6)
     "decomp",             # ...and the diagnostic that locates that gap
@@ -177,7 +194,8 @@ EXTRA_CHILDREN = {"decomp"}
 # but not the ordering would otherwise silently never run
 assert set(PRIORITY) == {
     n
-    for n in list(DECODE_CONFIGS) + list(SPEC_CONFIGS) + list(PREFILL_CONFIGS)
+    for n in list(DECODE_CONFIGS) + list(SPEC_CONFIGS)
+    + list(PREFILL_CONFIGS) + list(RAGGED_CONFIGS)
     if not n.startswith("smoke")
 } | EXTRA_CHILDREN, "PRIORITY out of sync with config dicts"
 
@@ -186,6 +204,8 @@ TIMEOUTS = {
     "gemma2_2b_bs8": 600,  # 2.6B params: first-touch compile + 3 reps
     "gemma2_2b_bs16": 600,  # same model, 2x tokens per rep
     "decomp": 700,  # 4 decode-loop compiles (full/half × bf16/int8) + head
+    "ragged_bs8_xla": 600,  # 2 prefill + 2 loop compiles + 3 rep pairs
+    "ragged_bs8_fdec": 600,
     # prefill-dominated: the marginal measurement's extra prefill+half
     # decode per rep nearly doubles measured-phase wall time
     "llama3b_seq2048_bs8": 700,
@@ -503,6 +523,88 @@ def run_prefill_config(name: str) -> dict:
     }
 
 
+def run_ragged_config(name: str) -> dict:
+    """Aggregate decode rate over a ragged batch (mixed prompt lengths,
+    left-padded).  Rates come from the difference of two matched calls
+    (full- vs half-length decode, identical prompt shapes): the prefill
+    cost and the fixed per-dispatch transport cancel in
+    Δtokens/Δtime, isolating the steady-state decode rate — the number
+    where the kernel's per-row block skipping should show up against the
+    XLA path's full-slab streaming."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from llm_np_cp_tpu.generate import Generator
+    from llm_np_cp_tpu.ops.sampling import Sampler
+
+    t0 = time.perf_counter()
+    spec = RAGGED_CONFIGS[name]
+    lens = spec.get("lens", RAGGED_LENS)
+    n_full = spec.get("decode", RAGGED_DECODE)
+    n_half = max(n_full // 2, 1)
+    b = len(lens)
+    config, params = _build_model(spec["model"], tag=name, t0=t0)
+    _phase(name, "params_built", t0)
+    gen = Generator(
+        params, config, sampler=Sampler(kind="greedy"),
+        decode_attn_impl=spec["attn"],
+    )
+    rng = np.random.default_rng(11)
+
+    def one(seed_val, tag):
+        prompts = [
+            (rng.integers(0, config.vocab_size, L) + seed_val)
+            % config.vocab_size
+            for L in lens
+        ]
+        t1 = time.perf_counter()
+        res_f = gen.generate_ragged(prompts, n_full, seed=int(seed_val) % 97)
+        t2 = time.perf_counter()
+        res_h = gen.generate_ragged(
+            [(p + 1) % config.vocab_size for p in prompts], n_half,
+            seed=int(seed_val) % 89,
+        )
+        t3 = time.perf_counter()
+        _phase(name, f"{tag}:pair_done", t0,
+               dt_full=round(t2 - t1, 1), dt_half=round(t3 - t2, 1))
+        return {
+            "t_full": t2 - t1,
+            "t_half": t3 - t2,
+            "ttft": res_f.ttft_s,
+            "extra_s": t3 - t2,
+            "chain": int(np.asarray(res_f.tokens).sum() % 10007)
+            + int(np.asarray(res_h.tokens).sum() % 101),
+        }
+
+    _, runs = _chained_reps(one, 3, 10**9)
+    t_full = float(np.median([r["t_full"] for r in runs]))
+    t_half = float(np.median([r["t_half"] for r in runs]))
+    marginal = (
+        b * (n_full - n_half) / (t_full - t_half)
+        if t_full > t_half * 1.05 else None
+    )
+    cap = int(np.ceil((max(lens) + n_full) / 128)) * 128
+    slab_gb = (
+        config.num_hidden_layers * 2 * b * cap
+        * config.num_key_value_heads * config.head_dim * 2 / 1e9
+    )
+    return {
+        "config": name,
+        "ok": True,
+        # e2e number includes prefill of the ragged batch; marginal is
+        # the steady-state decode rate (prefill+transport cancelled)
+        **({"decode_tok_s_chip_marginal": round(marginal, 1)}
+           if marginal is not None else {}),
+        "decode_tok_s_chip_e2e": round(b * n_full / t_full, 1),
+        "ttft_s_p50": round(float(np.median([r["ttft"] for r in runs])), 4),
+        "attn": spec["attn"],
+        "prompt_lens": list(lens),
+        "decode_tokens": n_full,
+        "cache_capacity": cap,
+        "cache_slab_gb": round(slab_gb, 2),
+    }
+
+
 def run_spec_config(name: str) -> dict:
     import numpy as np
 
@@ -590,9 +692,13 @@ def run_warm() -> dict:
     }
     key = jax.ShapeDtypeStruct((2,), jnp.uint32)
     done, failed = [], []
-    # PRIORITY order: a partial warm (timeout) still covers the headline
+    # PRIORITY order: a partial warm (timeout) still covers the headline.
+    # Spec/ragged configs build their programs inside Generator classes
+    # and aren't abstractly warmable here; they pay their own compiles.
     for name in [
-        n for n in PRIORITY if n not in SPEC_CONFIGS and n not in EXTRA_CHILDREN
+        n for n in PRIORITY
+        if n not in SPEC_CONFIGS and n not in EXTRA_CHILDREN
+        and n not in RAGGED_CONFIGS
     ]:
         spec = {**DECODE_CONFIGS, **PREFILL_CONFIGS}[name]
         config = configs[spec["model"]]
@@ -745,7 +851,7 @@ def run_decomp() -> dict:
         # are transport-cancelled — mixing an on-chip number with an
         # RTT-inclusive one would put the transport into fixed_ms, the
         # very thing the decomposition isolates
-        if rates[full_l][1] == rates[half_l][1] == "marginal":
+        if full_l > half_l and rates[full_l][1] == rates[half_l][1] == "marginal":
             per_layer_ms = (step_full_ms - step_half_ms) / (full_l - half_l)
             out[mode].update(
                 per_layer_ms=round(per_layer_ms, 4),
@@ -754,6 +860,8 @@ def run_decomp() -> dict:
         else:
             out[mode]["decomposition"] = (
                 "skipped: marginal rate unavailable at one or both depths"
+                if full_l > half_l
+                else "skipped: single-layer model has no depth contrast"
             )
 
     # lm_head alone, via the same two-length marginal trick the decode
@@ -877,6 +985,8 @@ def child_main(mode: str) -> None:
         out = run_prefill_config(mode)
     elif mode in SPEC_CONFIGS:
         out = run_spec_config(mode)
+    elif mode in RAGGED_CONFIGS:
+        out = run_ragged_config(mode)
     else:
         raise SystemExit(f"unknown config {mode!r}")
     print(json.dumps(out), flush=True)
@@ -1129,7 +1239,8 @@ def main() -> None:
             continue
         budget = min(TIMEOUTS.get(name, DEFAULT_TIMEOUT), remaining - 10)
         spec_env = {
-            **DECODE_CONFIGS, **PREFILL_CONFIGS, **SPEC_CONFIGS
+            **DECODE_CONFIGS, **PREFILL_CONFIGS, **SPEC_CONFIGS,
+            **RAGGED_CONFIGS,
         }.get(name, {}).get("env")
         res = _spawn(name, budget, env=spec_env)
         detail[name] = res

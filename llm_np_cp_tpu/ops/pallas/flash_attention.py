@@ -27,13 +27,34 @@ from jax.experimental.pallas import tpu as pltpu
 NEG_INF = float(jnp.finfo(jnp.float32).min)
 
 
+def _kv_block_bounds(
+    i, block_q: int, block_kv: int, window: int | None
+):
+    """Visible kv-block range [jmin, jmax] for q block ``i`` — pure
+    grid-index arithmetic, shared by the kernel gate and the BlockSpec
+    index maps (a kv block outside the range repeats the previous block
+    index, so its DMA is elided entirely).
+
+    Causal upper bound: first col ≤ the q block's last row.  Window lower
+    bound: the block is visible iff its last col is within ``window`` of
+    the q block's first row (the per-element mask finishes the job).
+    """
+    q_last = i * block_q + block_q - 1
+    jmax = q_last // block_kv
+    if window is None:
+        return 0, jmax
+    # smallest j with  i*block_q - (j*block_kv + block_kv - 1) < window
+    jmin = (i * block_q - window - block_kv + 1) // block_kv + 1
+    return jnp.maximum(jmin, 0), jmax
+
+
 def _flash_kernel(
     q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref,
     *, scale: float, block_q: int, block_kv: int,
     softcap: float | None, window: int | None, seq_len: int,
 ):
     i = pl.program_id(1)  # q block
-    j = pl.program_id(2)  # kv block
+    j = pl.program_id(2)  # kv block step (offset into the visible range)
     nj = pl.num_programs(2)
 
     @pl.when(j == 0)
@@ -42,21 +63,19 @@ def _flash_kernel(
         l_ref[:] = jnp.zeros_like(l_ref)
         acc_ref[:] = jnp.zeros_like(acc_ref)
 
+    jmin, jmax = _kv_block_bounds(i, block_q, block_kv, window)
     q_start = i * block_q
-    kv_start = j * block_kv
+    # the index maps clamp the fetched block to min(jmin + j, jmax);
+    # steps past the visible range re-see block jmax and skip compute
+    kv_start = jnp.minimum(jmin + j, jmax) * block_kv
 
-    # Causal: kv block visible iff its first col <= q block's last row.
-    # Window: kv block visible iff its last col is within `window` of the
-    # q block's last row.
-    visible = kv_start <= q_start + block_q - 1
-    if window is not None:
-        visible &= (q_start - (kv_start + block_kv - 1)) < window
-
-    @pl.when(visible)
+    @pl.when(jmin + j <= jmax)
     def _work():
-        q = q_ref[0].astype(jnp.float32)  # [block_q, D]
-        k = k_ref[0].astype(jnp.float32)  # [block_kv, D]
-        v = v_ref[0].astype(jnp.float32)
+        # bf16 MXU operands with f32 accumulation (same contract as the
+        # XLA path's einsums) — pre-casting to f32 ran the matmuls at the
+        # MXU's f32 rate and cost the r4 bench 23% vs XLA at 8k prefill
+        q = q_ref[0]  # [block_q, D]
+        k = k_ref[0]  # [block_kv, D]
 
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
@@ -77,7 +96,8 @@ def _flash_kernel(
         alpha = jnp.exp(m_prev - m_new)
         l_ref[:] = l_ref[:] * alpha + jnp.sum(p, axis=-1, keepdims=True)
         acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+            p.astype(v_ref.dtype), v_ref[0], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
         )
         m_ref[:] = m_new
 
@@ -140,8 +160,13 @@ def flash_attention(
         return (bh, i, 0)
 
     def kv_map(bh, i, j):
-        # query head bh → batch bh//h, kv head (bh%h)//g
-        return ((bh // h) * kh + (bh % h) // g, j, 0)
+        # query head bh → batch bh//h, kv head (bh%h)//g.  The kv block
+        # index clamps into the visible range for q block i: out-of-range
+        # steps repeat an already-fetched block, so the causal upper
+        # triangle (and, with a window, the stale lower band) is never
+        # streamed from HBM — the XLA path always streams all of K/V.
+        jmin, jmax = _kv_block_bounds(i, block_q, block_kv, window)
+        return ((bh // h) * kh + (bh % h) // g, jnp.minimum(jmin + j, jmax), 0)
 
     kernel = functools.partial(
         _flash_kernel,

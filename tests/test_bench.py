@@ -82,7 +82,7 @@ def test_priority_matches_config_dicts():
     non_smoke = {
         n
         for n in list(bench.DECODE_CONFIGS) + list(bench.SPEC_CONFIGS)
-        + list(bench.PREFILL_CONFIGS)
+        + list(bench.PREFILL_CONFIGS) + list(bench.RAGGED_CONFIGS)
         if not n.startswith("smoke")
     }
     assert set(bench.PRIORITY) == non_smoke | bench.EXTRA_CHILDREN
@@ -95,7 +95,18 @@ def test_warm_smoke_offline():
     assert res.get("ok") is True, res
     assert set(res["warmed"]) == {n for n in bench.PRIORITY
                                  if n not in bench.SPEC_CONFIGS
-                                 and n not in bench.EXTRA_CHILDREN}
+                                 and n not in bench.EXTRA_CHILDREN
+                                 and n not in bench.RAGGED_CONFIGS}
+
+
+def test_ragged_smoke_offline():
+    """The ragged decode child (mixed prompt lengths, marginal pair
+    measurement) runs end-to-end on CPU with the tiny model."""
+    res = bench._spawn("smoke_ragged", 600, env={"BENCH_PLATFORM": "cpu"})
+    assert res.get("ok") is True, res
+    assert res["decode_tok_s_chip_e2e"] > 0
+    assert res["prompt_lens"] == [24, 16, 9, 4]
+    assert res["cache_capacity"] % 128 == 0
 
 
 def test_decomp_smoke_offline():

@@ -123,9 +123,13 @@ def main() -> None:
         live["value"] = bs8["decode_tok_s_chip"]
         live["vs_baseline"] = round(live["value"] / NORTH_STAR_TOK_S, 3)
     # a merged artifact that now has real rows should not carry a stale
-    # tunnel-down error banner
-    if live.get("error") and any(
-        r.get("ok") for r in live["detail"].values() if isinstance(r, dict)
+    # tunnel-down error banner (idempotent across repeated merges)
+    if (
+        live.get("error")
+        and not live["error"].startswith("(superseded by merge)")
+        and any(
+            r.get("ok") for r in live["detail"].values() if isinstance(r, dict)
+        )
     ):
         live["error"] = f"(superseded by merge) {live['error']}"
     with open(artifact, "w") as f:
