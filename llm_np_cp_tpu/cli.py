@@ -53,11 +53,13 @@ def build_parser(default_model: str) -> argparse.ArgumentParser:
                    help="KV-cache storage dtype (auto = follow --dtype); "
                         "int8 stores per-token-per-head absmax-quantized "
                         "K/V, halving cache HBM traffic for long contexts")
-    p.add_argument("--quantize", choices=["none", "int8", "int4"],
+    p.add_argument("--quantize", choices=["none", "int8", "int8_a8", "int4"],
                    default="none",
-                   help="weight-only quantization: int8 halves decode HBM "
-                        "traffic, int4 packs projections two-per-byte "
-                        "(embed stays int8); composes with --mesh sharding")
+                   help="quantization: int8 (weight-only) halves decode HBM "
+                        "traffic, int8_a8 adds dynamic activation quant "
+                        "(int8×int8 MXU einsums; lossier, opt-in), int4 "
+                        "packs projections two-per-byte (embed stays int8); "
+                        "composes with --mesh sharding")
     p.add_argument("--mesh", default="1,1,1",
                    help="data,seq,model parallel degrees (e.g. 1,1,8 for TP=8)")
     p.add_argument("--max-seq-len", type=int, default=None,
@@ -81,8 +83,13 @@ def build_parser(default_model: str) -> argparse.ArgumentParser:
                         "Pallas kernel over the cache slab")
     p.add_argument("--speculative", type=int, default=0, metavar="GAMMA",
                    help="speculative decoding: GAMMA draft proposals per "
-                        "round from an int8 self-draft (exact target "
-                        "distribution; tpu backend, implies --no-stream)")
+                        "round (exact target distribution regardless of "
+                        "draft; tpu backend, implies --no-stream)")
+    p.add_argument("--draft", default="int8", metavar="KIND",
+                   help="draft model for --speculative: int8 (default) or "
+                        "int4 self-quantization, or truncN / truncN_int4 — "
+                        "a layer-skip draft from the target's first N "
+                        "layers (e.g. trunc8_int4)")
     p.add_argument("--metrics", action="store_true",
                    help="print tokens/sec and TTFT after generation")
     return p
@@ -90,6 +97,7 @@ def build_parser(default_model: str) -> argparse.ArgumentParser:
 
 def run(argv: list[str] | None = None, default_model: str = "meta-llama/Llama-3.2-1B") -> str:
     args = build_parser(default_model).parse_args(argv)
+    _validate_draft(args)
     if args.prompts_file and (args.backend == "numpy" or args.speculative > 0):
         raise SystemExit(
             "--prompts-file batches through the tpu Generator; the numpy "
@@ -112,6 +120,61 @@ def run(argv: list[str] | None = None, default_model: str = "meta-llama/Llama-3.
                              "(the numpy oracle is fp32 by definition)")
         return _run_numpy(args)
     return _run_tpu(args)
+
+
+def _parse_draft(kind: str) -> tuple[int | None, bool]:
+    """--draft KIND → (trunc_layers | None, int4).  Raises SystemExit on
+    malformed kinds — called at parse time, before any model load."""
+    import re
+
+    if kind == "int8":
+        return None, False
+    if kind == "int4":
+        return None, True
+    m = re.fullmatch(r"trunc(\d+)(_int4)?", kind)
+    if m is None or int(m.group(1)) < 1:
+        raise SystemExit(
+            f"--draft must be int8, int4, truncN or truncN_int4; got {kind!r}"
+        )
+    return int(m.group(1)), bool(m.group(2))
+
+
+def _validate_draft(args) -> None:
+    """Fail fast on bad --draft combinations, before the model loads."""
+    trunc_layers, int4 = _parse_draft(args.draft)
+    if args.draft != "int8" and args.speculative == 0:
+        raise SystemExit("--draft requires --speculative GAMMA")
+    if int4 and args.quantize != "none":
+        # re-quantizing already-quantized dict leaves is undefined; the
+        # int8 self-draft (reuse-the-target guard) and plain truncN
+        # (slices quantized leaves fine) both compose with --quantize
+        raise SystemExit(
+            f"--draft {args.draft} requires an unquantized target; with "
+            f"--quantize {args.quantize}, use --draft int8 or truncN"
+        )
+
+
+def _draft_kwargs(kind: str, params: Any, config: Any) -> dict[str, Any]:
+    """--draft KIND → SpeculativeGenerator draft kwargs.
+
+    int8 is the class default (empty kwargs); int4 quantizes the target's
+    projections to 4 bits; truncN[_int4] takes the target's first N
+    layers (speculative.truncated_draft), optionally int4-quantized.
+    Combination validity was checked at parse time (_validate_draft).
+    """
+    trunc_layers, int4 = _parse_draft(kind)
+    if trunc_layers is not None:
+        from llm_np_cp_tpu.speculative import truncated_draft
+
+        dp, dc = truncated_draft(
+            params, config, trunc_layers, bits=4 if int4 else None
+        )
+        return {"draft_params": dp, "draft_config": dc}
+    if int4:
+        from llm_np_cp_tpu.quant import quantize_params
+
+        return {"draft_params": quantize_params(params, bits=4)}
+    return {}
 
 
 def _load(args) -> tuple[Any, Any, Any]:
@@ -222,7 +285,8 @@ def _run_tpu(args) -> str:
         from llm_np_cp_tpu.quant import quantize_params
 
         params = quantize_params(
-            params, bits=4 if args.quantize == "int4" else 8
+            params, bits=4 if args.quantize == "int4" else 8,
+            act_quant=args.quantize == "int8_a8",
         )
     mesh = None
     if plan.num_devices > 1:
@@ -264,14 +328,15 @@ def _run_tpu(args) -> str:
     if args.speculative > 0:
         from llm_np_cp_tpu.speculative import SpeculativeGenerator
 
-        # Under the mesh context from construction on: the int8 self-draft
-        # re-quantizes the (possibly sharded) params, and every spec jit
-        # must see the same mesh as the target model's (VERDICT r2 weak #5:
-        # this branch used to run before jax.set_mesh entirely).
+        # Under the mesh context from construction on: the draft derives
+        # from the (possibly sharded) params, and every spec jit must see
+        # the same mesh as the target model's (VERDICT r2 weak #5: this
+        # branch used to run before jax.set_mesh entirely).
         with ctx:
             spec = SpeculativeGenerator(
                 params, config, gamma=args.speculative, sampler=sampler,
                 cache_dtype=cache_dtype, prefill_chunk=args.prefill_chunk,
+                **_draft_kwargs(args.draft, params, config),
             )
             prompt_ids = tok(args.prompt, return_tensors="np")["input_ids"][0]
             res = spec.generate(

@@ -10,11 +10,14 @@ into the GEMM's operand pipeline, so HBM traffic halves while the MXU
 still runs bf16×bf16.
 
 Representation: a quantized matrix is a dict in the original array's
-pytree position — ``{"q": int8, "s": f32}`` (8-bit) or ``{"q4": uint8
+pytree position — ``{"q": int8, "s": f32}`` (8-bit), ``{"q4": uint8
 two-nibbles-per-byte packed along the contraction axis, "s": f32}``
-(4-bit; see quantize_array4) — with ``s`` broadcast along the *input*
-axis (consumers: ``payload()`` / ``payload_key()`` below, quant_einsum,
-sharding.shard_params):
+(4-bit; see quantize_array4), or ``{"qa": int8, "s": f32}`` (8-bit
+weights consumed with DYNAMIC per-token int8 activation quantization:
+the einsum runs int8×int8 on the MXU's native int8 path, skipping the
+per-element int8→bf16 weight convert of the ``q`` mode — W8A8) — with
+``s`` broadcast along the *input* axis (consumers: ``payload()`` /
+``payload_key()`` below, quant_einsum, sharding.shard_params):
 
 - projections ``[in, out]`` → per-out-channel scale ``[out]``
 - stacked layers ``[L, in, out]`` → ``[L, 1, out]``
@@ -46,16 +49,25 @@ _QUANT_KEYS = {
 
 
 def is_quantized(w: Any) -> bool:
-    return isinstance(w, dict) and ("q" in w or "q4" in w) and "s" in w
+    return (
+        isinstance(w, dict)
+        and ("q" in w or "q4" in w or "qa" in w)
+        and "s" in w
+    )
 
 
 def payload_key(w: dict) -> str:
-    return "q" if "q" in w else "q4"
+    for k in ("q", "qa", "q4"):
+        if k in w:
+            return k
+    raise KeyError(f"not a quantized leaf: {list(w)}")
 
 
 def payload(w: dict) -> jnp.ndarray:
     """The quantized leaf's full-width integer payload (int4 unpacked)."""
-    return w["q"] if "q" in w else _unpack4(w["q4"])
+    if "q4" in w:
+        return _unpack4(w["q4"])
+    return w[payload_key(w)]
 
 
 def quantize_array(w: jnp.ndarray, *, axis: int) -> dict[str, jnp.ndarray]:
@@ -113,7 +125,10 @@ def dequantize(w: Any, dtype: jnp.dtype = jnp.float32) -> jnp.ndarray:
     return (payload(w).astype(jnp.float32) * w["s"]).astype(dtype)
 
 
-def quantize_params(params: Params, *, embed: bool = True, bits: int = 8) -> Params:
+def quantize_params(
+    params: Params, *, embed: bool = True, bits: int = 8,
+    act_quant: bool = False,
+) -> Params:
     """Quantize every projection matrix (and optionally the embedding /
     tied lm_head table) of a transformer param pytree in place-shape.
 
@@ -122,6 +137,16 @@ def quantize_params(params: Params, *, embed: bool = True, bits: int = 8) -> Par
     visible quality for a small byte win, and the lm_head matmul is once
     per step, not per layer.
 
+    ``act_quant=True`` (bits=8 only) marks the per-layer projections for
+    dynamic activation quantization (payload key ``qa``): quant_einsum
+    quantizes each token's activations to int8 on the fly (per-row absmax)
+    and contracts int8×int8 with int32 accumulation — the MXU's native
+    int8 path, no weight convert in the operand stream.  The embed /
+    lm_head table keeps the weight-only ``q`` mode (it serves the gather
+    too, and logits set output quality).  Quality cost is measured by
+    utils/quality.py's ``int8_a8`` mode — activation outliers make this
+    lossier than weight-only int8; it is opt-in.
+
     The result drops into ``models.transformer.forward`` unchanged —
     ``_project`` / ``embed_inputs`` / ``final_logits`` detect the dict
     leaves — and into ``parallel.sharding.shard_params``, which shards the
@@ -129,6 +154,8 @@ def quantize_params(params: Params, *, embed: bool = True, bits: int = 8) -> Par
     """
     if bits not in (4, 8):
         raise ValueError(f"bits must be 4 or 8, got {bits}")
+    if act_quant and bits != 8:
+        raise ValueError("act_quant requires bits=8 (int8×int8 MXU path)")
     qproj = quantize_array4 if bits == 4 else quantize_array
     out = dict(params)
     layers = dict(params["layers"])
@@ -136,7 +163,10 @@ def quantize_params(params: Params, *, embed: bool = True, bits: int = 8) -> Par
         if key in _QUANT_KEYS:
             # stacked [L, in, out] (dense) or [L, E, in, out] (MoE experts):
             # contraction axis is always -2
-            layers[key] = qproj(layers[key], axis=-2)
+            w = qproj(layers[key], axis=-2)
+            if act_quant:
+                w = {"qa": w.pop("q"), **w}
+            layers[key] = w
     out["layers"] = layers
     if embed:
         # [V, H]: per-row scales serve both the embed gather and the tied
@@ -165,13 +195,47 @@ def _align_scale(spec: str, s: jnp.ndarray) -> jnp.ndarray:
     ])
 
 
+def _align_x_scale(spec: str, sx: jnp.ndarray) -> jnp.ndarray:
+    """Reshape a keepdims ACTIVATION scale (same rank as the einsum's
+    first operand, size 1 on contracted axes) to broadcast against the
+    einsum's output — the x-side twin of _align_scale."""
+    ins, out = spec.replace(" ", "").split("->")
+    x_idx, _ = ins.split(",")
+    drop = tuple(i for i, c in enumerate(x_idx) if c not in out)
+    s2 = jnp.squeeze(sx, axis=drop)
+    kept = [c for c in x_idx if c in out]
+    s2 = jnp.transpose(s2, sorted(range(len(kept)), key=lambda i: out.index(kept[i])))
+    kept_sorted = sorted(kept, key=out.index)
+    return s2.reshape([
+        s2.shape[kept_sorted.index(c)] if c in kept_sorted else 1 for c in out
+    ])
+
+
 def quant_einsum(spec: str, x: jnp.ndarray, w: Any) -> jnp.ndarray:
     """``einsum(spec, x, w)`` in f32 accumulation, accepting a plain array
-    or a quantized ``{"q"|"q4", "s"}`` dict for ``w`` (matmul the unpacked
-    payload in x.dtype, then rescale the output).  All weight-consuming
-    einsums in the model go through this."""
+    or a quantized ``{"q"|"qa"|"q4", "s"}`` dict for ``w``.  ``q``/``q4``
+    matmul the (unpacked) payload in x.dtype and rescale the output;
+    ``qa`` additionally quantizes the activations on the fly (dynamic
+    per-row absmax) and contracts int8×int8 with int32 accumulation —
+    the W8A8 path.  All weight-consuming einsums in the model go through
+    this."""
     if not is_quantized(w):
         return jnp.einsum(spec, x, w, preferred_element_type=jnp.float32)
+    if "qa" in w:
+        ins, out = spec.replace(" ", "").split("->")
+        x_idx, _ = ins.split(",")
+        contracted = tuple(i for i, c in enumerate(x_idx) if c not in out)
+        amax = jnp.max(
+            jnp.abs(x.astype(jnp.float32)), axis=contracted, keepdims=True
+        )
+        sx = jnp.where(amax > 0, amax / 127.0, 1.0)
+        xq = jnp.clip(jnp.round(x.astype(jnp.float32) / sx), -127, 127).astype(
+            jnp.int8
+        )
+        y = jnp.einsum(
+            spec, xq, w["qa"], preferred_element_type=jnp.int32
+        ).astype(jnp.float32)
+        return y * _align_x_scale(spec, sx) * _align_scale(spec, w["s"])
     if "q4" in w:
         y = _einsum4(spec, x, w["q4"])
     else:

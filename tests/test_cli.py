@@ -131,6 +131,13 @@ def test_cli_quantize_int4(fake_load, capsys):
     assert isinstance(text, str) and text
 
 
+def test_cli_quantize_int8_a8_runs(fake_load, capsys):
+    text = cli.run(["--backend=tpu", "--quantize=int8_a8", "--sampler=greedy",
+                    "--max-tokens=5", "--dtype=f32", "--no-stream",
+                    "--prompt=hello"])
+    assert isinstance(text, str) and text
+
+
 def test_cli_quantize_rejects_numpy_backend(fake_load):
     with pytest.raises(SystemExit, match="tpu backend only"):
         cli.run(["--backend=numpy", "--quantize=int8"])
@@ -144,6 +151,49 @@ def test_cli_speculative(fake_load, capsys):
                    "--dtype=f32", "--no-stream", "--prompt=hello"])
     assert text == ref  # speculative greedy is lossless
     assert "accept" in capsys.readouterr().err
+
+
+def test_cli_speculative_draft_kinds(fake_load, capsys):
+    """--draft {int4, truncN, truncN_int4}: every draft kind is lossless
+    under greedy (the accept/resample rule guarantees it)."""
+    ref = cli.run(["--backend=tpu", "--sampler=greedy", "--max-tokens=8",
+                   "--dtype=f32", "--no-stream", "--prompt=hello"])
+    for kind in ("int4", "trunc1", "trunc2_int4"):
+        text = cli.run(["--backend=tpu", "--speculative=2", "--sampler=greedy",
+                        f"--draft={kind}", "--max-tokens=8", "--dtype=f32",
+                        "--prompt=hello"])
+        assert text == ref, kind
+
+
+def test_cli_speculative_rejects_bad_draft(fake_load):
+    with pytest.raises(SystemExit, match="--draft must be"):
+        cli.run(["--backend=tpu", "--speculative=2", "--draft=bogus",
+                 "--max-tokens=2", "--dtype=f32"])
+    # typo'd kinds fail at parse time, not after model load
+    with pytest.raises(SystemExit, match="--draft must be"):
+        cli.run(["--backend=tpu", "--speculative=2", "--draft=trunk8",
+                 "--max-tokens=2", "--dtype=f32"])
+    with pytest.raises(SystemExit, match="requires --speculative"):
+        cli.run(["--backend=tpu", "--draft=int4", "--max-tokens=2",
+                 "--dtype=f32"])
+    # an int4 draft cannot be derived from an already-quantized target
+    with pytest.raises(SystemExit, match="unquantized target"):
+        cli.run(["--backend=tpu", "--speculative=2", "--quantize=int8",
+                 "--draft=trunc2_int4", "--max-tokens=2", "--dtype=f32"])
+
+
+def test_cli_speculative_trunc_draft_composes_with_quantized_target(
+    fake_load, capsys
+):
+    """--draft truncN slices already-quantized leaves; greedy output must
+    equal the plain quantized generator's."""
+    ref = cli.run(["--backend=tpu", "--quantize=int8", "--sampler=greedy",
+                   "--max-tokens=6", "--dtype=f32", "--no-stream",
+                   "--prompt=hello"])
+    got = cli.run(["--backend=tpu", "--quantize=int8", "--speculative=2",
+                   "--draft=trunc2", "--sampler=greedy", "--max-tokens=6",
+                   "--dtype=f32", "--prompt=hello"])
+    assert got == ref
 
 
 def test_cli_speculative_under_mesh(fake_load, capsys):

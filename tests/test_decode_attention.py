@@ -47,6 +47,44 @@ def test_softcap_parity():
     np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5)
 
 
+def test_block_bounds_cover_exactly_the_visible_blocks():
+    """_block_bounds must include every block containing a visible slot
+    (correctness) and exclude fully-invisible prefix/suffix blocks (the
+    DMA-skip win); fully-masked rows degrade to one block."""
+    from llm_np_cp_tpu.ops.pallas.decode_attention import _block_bounds
+
+    block_s, n_blocks = 8, 4
+    cases = [
+        (np.r_[np.zeros(16, bool), np.ones(8, bool), np.zeros(8, bool)], 2, 3),
+        (np.ones(32, bool), 0, 4),                   # all visible
+        (np.zeros(32, bool), 0, 1),                  # nothing visible
+        (np.r_[np.ones(1, bool), np.zeros(31, bool)], 0, 1),   # first slot only
+        (np.r_[np.zeros(31, bool), np.ones(1, bool)], 3, 4),   # last slot only
+    ]
+    mask = jnp.asarray(np.stack([c[0] for c in cases]))
+    bounds = np.asarray(_block_bounds(mask, block_s, n_blocks))
+    for i, (_, want_start, want_nb) in enumerate(cases):
+        assert bounds[0, i] == want_start, f"case {i} start"
+        assert bounds[1, i] == want_nb, f"case {i} nb"
+
+
+def test_middle_band_mask_parity():
+    """A visibility band in the middle of the slab (blocks skipped on both
+    sides) must still match the oracle — guards the clamp arithmetic."""
+    rng = np.random.default_rng(5)
+    b, s, h, kh, d = 2, 256, 4, 2, 16
+    q = _rand(rng, (b, 1, h, d))
+    k = _rand(rng, (b, s, kh, d))
+    v = _rand(rng, (b, s, kh, d))
+    mask = np.zeros((b, s), bool)
+    mask[0, 100:140] = True   # spans blocks 1-2 of 4 at block_s=64
+    mask[1, 250:] = True      # last block only
+    mask = jnp.asarray(mask)
+    want = gqa_attention(q, k, v, mask[:, None, :], scale=d**-0.5)
+    got = decode_attention(q, k, v, mask, scale=d**-0.5, block_s=64)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5)
+
+
 def test_decode_loop_token_parity():
     """Full fused decode loop with attn_impl='flash_decode' emits the same
     greedy tokens as the XLA loop, from the same prefilled cache."""

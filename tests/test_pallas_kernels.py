@@ -60,6 +60,23 @@ def test_flash_sliding_window(rng_np):
     np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5)
 
 
+@pytest.mark.parametrize("window", [1, 31, 33, 64, 300])
+def test_flash_window_block_boundaries(rng_np, window):
+    """Windows straddling block boundaries (±1 off multiples, narrower
+    than a block, wider than the sequence) — stresses the jmin clamp
+    arithmetic that elides stale-band KV DMAs."""
+    b, s, h, kh, d = 1, 256, 2, 1, 16
+    q = jnp.asarray(rng_np.standard_normal((b, s, h, d), dtype=np.float32))
+    k = jnp.asarray(rng_np.standard_normal((b, s, kh, d), dtype=np.float32))
+    v = jnp.asarray(rng_np.standard_normal((b, s, kh, d), dtype=np.float32))
+    want = _xla_reference(q, k, v, scale=0.25, window=window)
+    got = flash_attention(
+        q, k, v, scale=0.25, window=window, block_q=32, block_kv=32,
+        interpret=True,
+    )
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5)
+
+
 def test_flash_softcap(rng_np):
     b, s, h, kh, d = 1, 64, 2, 1, 16
     q = jnp.asarray(rng_np.standard_normal((b, s, h, d), dtype=np.float32) * 3)

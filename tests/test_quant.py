@@ -84,6 +84,79 @@ def test_quantized_cached_decode_matches_nocache():
     np.testing.assert_allclose(np.asarray(got), np.asarray(ref[:, 5:8]), atol=2e-4)
 
 
+def test_w8a8_einsum_matches_manual_dequant():
+    """The qa (int8×int8, int32-accumulate) einsum equals quantizing both
+    operands by hand and contracting in float — exactly, since int32
+    accumulation is lossless for these sizes."""
+    from llm_np_cp_tpu.quant import quant_einsum, quantize_array
+
+    rng = np.random.default_rng(4)
+    x = jnp.asarray(rng.normal(size=(2, 3, 64)) * 0.8, jnp.float32)
+    w = jnp.asarray(rng.normal(size=(64, 32)) * 0.3, jnp.float32)
+    qw = quantize_array(w, axis=0)
+    qa = {"qa": qw["q"], "s": qw["s"]}
+    got = np.asarray(quant_einsum("bsh,ho->bso", x, qa))
+
+    sx = np.abs(np.asarray(x)).max(axis=-1, keepdims=True) / 127.0
+    sx = np.where(sx > 0, sx, 1.0)
+    xq = np.clip(np.round(np.asarray(x) / sx), -127, 127)
+    want = np.einsum("bsh,ho->bso", xq, np.asarray(qw["q"], np.float64))
+    want = want * sx * np.asarray(qw["s"]).reshape(1, 1, -1)
+    np.testing.assert_allclose(got, want.astype(np.float32), rtol=1e-6)
+
+
+def test_w8a8_forward_tracks_float():
+    cfg = tiny_config("llama")
+    params = init_params(jax.random.PRNGKey(5), cfg, dtype=jnp.float32)
+    qparams = quantize_params(params, act_quant=True)
+    assert is_quantized(qparams["layers"]["q_proj"])
+    assert "qa" in qparams["layers"]["q_proj"]
+    # embed/head stay weight-only int8 (serves the gather too)
+    assert "q" in qparams["embed_tokens"]
+
+    ids = jnp.asarray(
+        np.random.default_rng(5).integers(0, cfg.vocab_size, (2, 12)), jnp.int32
+    )
+    ref, _ = forward(params, ids, cfg, None)
+    got, _ = forward(qparams, ids, cfg, None)
+    ref, got = np.asarray(ref), np.asarray(got)
+    scale = np.abs(ref).max()
+    assert np.abs(got - ref).max() < 0.08 * scale
+    assert (ref.argmax(-1) == got.argmax(-1)).mean() > 0.85
+
+
+def test_w8a8_sharded_generation_runs():
+    """qa leaves shard like q leaves (payload_key covers them) and the
+    fused decode loop runs end-to-end."""
+    from llm_np_cp_tpu.generate import Generator
+    from llm_np_cp_tpu.ops.sampling import Sampler
+    from llm_np_cp_tpu.parallel.sharding import MeshPlan, make_mesh, shard_params
+
+    cfg = tiny_config("llama")
+    params = quantize_params(
+        init_params(jax.random.PRNGKey(6), cfg, dtype=jnp.float32),
+        act_quant=True,
+    )
+    plan = MeshPlan(model=2)
+    mesh = make_mesh(plan)
+    sharded = shard_params(params, cfg, plan, mesh)
+    with jax.set_mesh(mesh):
+        gen = Generator(sharded, cfg, sampler=Sampler(kind="greedy"),
+                        cache_dtype=jnp.float32)
+        res = gen.generate(np.arange(10, dtype=np.int32) % cfg.vocab_size, 8)
+    assert res.tokens.shape == (1, 8)
+    assert np.all(res.tokens >= 0)
+
+
+def test_w8a8_requires_8_bits():
+    cfg = tiny_config("llama")
+    params = init_params(jax.random.PRNGKey(7), cfg, dtype=jnp.float32)
+    import pytest
+
+    with pytest.raises(ValueError, match="act_quant requires bits=8"):
+        quantize_params(params, bits=4, act_quant=True)
+
+
 def test_param_bytes_shrink():
     cfg = tiny_config("llama")
     params = init_params(jax.random.PRNGKey(3), cfg, dtype=jnp.bfloat16)
