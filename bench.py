@@ -210,7 +210,7 @@ TIMEOUTS = {
     "llama1b_bs8": 600,
     "gemma2_2b_bs8": 600,  # 2.6B params: first-touch compile + 3 reps
     "gemma2_2b_bs16": 600,  # same model, 2x tokens per rep
-    "decomp": 700,  # 4 decode-loop compiles (full/half × bf16/int8) + head
+    "decomp": 850,  # 6 decode-loop compiles (full/half × 3 quant modes) + head
     "ragged_bs8_xla": 600,  # 2 prefill + 2 loop compiles + 3 rep pairs
     "ragged_bs8_fdec": 600,
     # prefill-dominated: the marginal measurement's extra prefill+half
@@ -843,8 +843,11 @@ def run_decomp() -> dict:
     full_l = config.num_hidden_layers
     half_l = max(full_l // 2, 1)
 
-    for mode in ("bf16", "int8"):
-        p = quantize_params(params) if mode == "int8" else params
+    for mode in ("bf16", "int8", "int8_a8"):
+        p = (
+            params if mode == "bf16"
+            else quantize_params(params, act_quant=mode == "int8_a8")
+        )
         rates: dict[int, tuple[float, str]] = {}
         for n_layers in (full_l, half_l):
             pl_, cl = (

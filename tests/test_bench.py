@@ -119,7 +119,7 @@ def test_decomp_smoke_offline():
         env={"BENCH_PLATFORM": "cpu", "DECOMP_MODEL": "tiny"},
     )
     assert res.get("ok") is True, res
-    for mode in ("bf16", "int8"):
+    for mode in ("bf16", "int8", "int8_a8"):
         block = res[mode]
         assert block["step_ms"] > 0
         assert set(block["rate_sources"]) <= {"marginal", "e2e"}

@@ -1,0 +1,86 @@
+"""Deviceless TPU lowering of the Pallas kernels at REAL model shapes.
+
+Interpret-mode tests validate kernel math but not Mosaic's layout rules
+(r3 postmortem: a kernel that passed every CPU test was rejected by
+Mosaic at first hardware compile).  ``jax.export`` with
+``platforms=["tpu"]`` runs the Pallas→Mosaic serialization — where the
+block-shape/trailing-dims rules live — without a chip, so a layout
+regression fails HERE instead of burning a scarce tunnel window.  (The
+final Mosaic→machine-code compile still only happens on hardware; the
+bench's ``kernels`` child and ops/pallas/support.py cover that.)
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import pytest
+from jax import export
+
+from llm_np_cp_tpu.ops.pallas.decode_attention import decode_attention
+from llm_np_cp_tpu.ops.pallas.flash_attention import flash_attention
+
+
+def _export_tpu(fn, *args):
+    exp = export.export(jax.jit(fn), platforms=["tpu"])(*args)
+    assert exp.platforms == ("tpu",)
+
+
+# llama-3.2-1B headline decode shape: bs=8, 512-slot cache, 32 q heads
+B, S, H, KH, D = 8, 512, 32, 8, 64
+
+
+def test_decode_attention_lowers_for_tpu():
+    q = jax.ShapeDtypeStruct((B, 1, H, D), jnp.bfloat16)
+    kv = jax.ShapeDtypeStruct((B, S, KH, D), jnp.bfloat16)
+    mask = jax.ShapeDtypeStruct((B, S), jnp.bool_)
+    _export_tpu(
+        functools.partial(decode_attention, scale=0.125, interpret=False),
+        q, kv, kv, mask,
+    )
+
+
+def test_decode_attention_int8_lowers_for_tpu():
+    q = jax.ShapeDtypeStruct((B, 1, H, D), jnp.bfloat16)
+    kv8 = jax.ShapeDtypeStruct((B, S, KH, D), jnp.int8)
+    mask = jax.ShapeDtypeStruct((B, S), jnp.bool_)
+    sc = jax.ShapeDtypeStruct((B, S, KH), jnp.float32)
+    fn = functools.partial(decode_attention, scale=0.125, interpret=False)
+    _export_tpu(
+        lambda q_, k_, v_, m_, ks_, vs_: fn(
+            q_, k_, v_, m_, k_scale=ks_, v_scale=vs_
+        ),
+        q, kv8, kv8, mask, sc, sc,
+    )
+
+
+@pytest.mark.parametrize(
+    "window,softcap", [(None, None), (4096, 50.0)],
+    ids=["causal", "gemma2_window_softcap"],
+)
+def test_flash_attention_8k_lowers_for_tpu(window, softcap):
+    s = 8192
+    q = jax.ShapeDtypeStruct((1, s, H, D), jnp.bfloat16)
+    kv = jax.ShapeDtypeStruct((1, s, KH, D), jnp.bfloat16)
+    _export_tpu(
+        functools.partial(
+            flash_attention, scale=0.125, window=window,
+            logit_softcap=softcap, interpret=False,
+        ),
+        q, kv, kv,
+    )
+
+
+def test_gemma2_decode_shape_lowers_for_tpu():
+    # Gemma-2-2B: 8 q heads over 4 KV heads of 256 dim — the wide-head
+    # layout class (trailing dims (4, 256))
+    q = jax.ShapeDtypeStruct((8, 1, 8, 256), jnp.bfloat16)
+    kv = jax.ShapeDtypeStruct((8, 512, 4, 256), jnp.bfloat16)
+    mask = jax.ShapeDtypeStruct((8, 512), jnp.bool_)
+    _export_tpu(
+        functools.partial(
+            decode_attention, scale=0.0625, logit_softcap=50.0,
+            interpret=False,
+        ),
+        q, kv, kv, mask,
+    )
