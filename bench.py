@@ -85,10 +85,12 @@ DECODE_CONFIGS = {
     "int8_bs8": dict(model="llama1b", batch=8, prompt_len=128, decode_tokens=256, quant=True),
     "int4_bs8": dict(model="llama1b", batch=8, prompt_len=128, decode_tokens=256,
                      quant="int4"),
-    # W8A8: int8×int8 MXU einsums (no weight convert in the operand
-    # stream) — the candidate fix for int8's 47.5%-of-roofline gap
+    # W8A8 / W4A8: all-integer MXU einsums (no weight convert in the
+    # operand stream) — the candidate fix for int8's 47.5%-of-roofline gap
     "int8a8_bs8": dict(model="llama1b", batch=8, prompt_len=128,
                        decode_tokens=256, quant="int8_a8"),
+    "int4a8_bs8": dict(model="llama1b", batch=8, prompt_len=128,
+                       decode_tokens=256, quant="int4_a8"),
     "gemma2_2b_bs1": dict(model="gemma2_2b", batch=1, prompt_len=128, decode_tokens=256),
     # Gemma-2 aggregate configs (VERDICT r4 task 3): the north star names
     # BOTH models at >1k tok/s/chip; at bs=1 a 5.23 GB model is
@@ -178,6 +180,7 @@ PRIORITY = [
     "gemma2_2b_bs8",      # Gemma north-star number (VERDICT task 3)
     "int8_bs8",           # roofline-gap anchor (VERDICT task 6)
     "int8a8_bs8",         # W8A8 int8-MXU einsums vs that anchor
+    "int4a8_bs8",         # W4A8: ¼ weight stream, all-integer contraction
     "decomp",             # ...and the diagnostic that locates that gap
     "llama3b_seq2048_bs8",  # BASELINE config 3 — no number in 4 rounds (task 4)
     "llama1b_bs8_unroll2",  # layer-scan unroll experiment vs bs8
@@ -285,12 +288,12 @@ def _build_model(name: str, quant=False, tag: str | None = None, t0: float | Non
     params = init_params(jax.random.PRNGKey(0), config, dtype=jnp.bfloat16)
     # fence: make "params_built" mean MATERIALIZED, not just dispatched
     np.asarray(jax.tree.leaves(params)[0][..., :1])
-    if quant:  # True/"int8" → 8-bit, "int4" → 4-bit, "int8_a8" → W8A8
+    if quant:  # True/"int8" → 8-bit, "int4" → 4-bit, "*_a8" → act quant
         from llm_np_cp_tpu.quant import quantize_params
 
         params = quantize_params(
-            params, bits=4 if quant == "int4" else 8,
-            act_quant=quant == "int8_a8",
+            params, bits=4 if str(quant).startswith("int4") else 8,
+            act_quant=str(quant).endswith("_a8"),
         )
     return config, params
 
@@ -729,8 +732,8 @@ def run_warm() -> dict:
                 from llm_np_cp_tpu.quant import quantize_params
 
                 params = quantize_params(
-                    params, bits=4 if quant == "int4" else 8,
-                    act_quant=quant == "int8_a8",
+                    params, bits=4 if str(quant).startswith("int4") else 8,
+                    act_quant=str(quant).endswith("_a8"),
                 )
             return params
 

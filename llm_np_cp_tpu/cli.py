@@ -53,13 +53,14 @@ def build_parser(default_model: str) -> argparse.ArgumentParser:
                    help="KV-cache storage dtype (auto = follow --dtype); "
                         "int8 stores per-token-per-head absmax-quantized "
                         "K/V, halving cache HBM traffic for long contexts")
-    p.add_argument("--quantize", choices=["none", "int8", "int8_a8", "int4"],
+    p.add_argument("--quantize",
+                   choices=["none", "int8", "int8_a8", "int4", "int4_a8"],
                    default="none",
                    help="quantization: int8 (weight-only) halves decode HBM "
-                        "traffic, int8_a8 adds dynamic activation quant "
-                        "(int8×int8 MXU einsums; lossier, opt-in), int4 "
-                        "packs projections two-per-byte (embed stays int8); "
-                        "composes with --mesh sharding")
+                        "traffic, int4 packs projections two-per-byte "
+                        "(embed stays int8); the _a8 variants add dynamic "
+                        "activation quant (all-integer MXU einsums; "
+                        "lossier, opt-in); composes with --mesh sharding")
     p.add_argument("--mesh", default="1,1,1",
                    help="data,seq,model parallel degrees (e.g. 1,1,8 for TP=8)")
     p.add_argument("--max-seq-len", type=int, default=None,
@@ -285,8 +286,8 @@ def _run_tpu(args) -> str:
         from llm_np_cp_tpu.quant import quantize_params
 
         params = quantize_params(
-            params, bits=4 if args.quantize == "int4" else 8,
-            act_quant=args.quantize == "int8_a8",
+            params, bits=4 if args.quantize.startswith("int4") else 8,
+            act_quant=args.quantize.endswith("_a8"),
         )
     mesh = None
     if plan.num_devices > 1:

@@ -48,16 +48,19 @@ _QUANT_KEYS = {
 }
 
 
+_PAYLOAD_KEYS = ("q", "qa", "q4", "q4a")
+
+
 def is_quantized(w: Any) -> bool:
     return (
         isinstance(w, dict)
-        and ("q" in w or "q4" in w or "qa" in w)
+        and any(k in w for k in _PAYLOAD_KEYS)
         and "s" in w
     )
 
 
 def payload_key(w: dict) -> str:
-    for k in ("q", "qa", "q4"):
+    for k in _PAYLOAD_KEYS:
         if k in w:
             return k
     raise KeyError(f"not a quantized leaf: {list(w)}")
@@ -65,9 +68,10 @@ def payload_key(w: dict) -> str:
 
 def payload(w: dict) -> jnp.ndarray:
     """The quantized leaf's full-width integer payload (int4 unpacked)."""
-    if "q4" in w:
-        return _unpack4(w["q4"])
-    return w[payload_key(w)]
+    key = payload_key(w)
+    if key in ("q4", "q4a"):
+        return _unpack4(w[key])
+    return w[key]
 
 
 def quantize_array(w: jnp.ndarray, *, axis: int) -> dict[str, jnp.ndarray]:
@@ -137,15 +141,16 @@ def quantize_params(
     visible quality for a small byte win, and the lm_head matmul is once
     per step, not per layer.
 
-    ``act_quant=True`` (bits=8 only) marks the per-layer projections for
-    dynamic activation quantization (payload key ``qa``): quant_einsum
-    quantizes each token's activations to int8 on the fly (per-row absmax)
-    and contracts int8×int8 with int32 accumulation — the MXU's native
-    int8 path, no weight convert in the operand stream.  The embed /
-    lm_head table keeps the weight-only ``q`` mode (it serves the gather
-    too, and logits set output quality).  Quality cost is measured by
-    utils/quality.py's ``int8_a8`` mode — activation outliers make this
-    lossier than weight-only int8; it is opt-in.
+    ``act_quant=True`` marks the per-layer projections for dynamic
+    activation quantization (payload key ``qa`` at bits=8, ``q4a`` at
+    bits=4): quant_einsum quantizes each token's activations to int8 on
+    the fly (per-row absmax) and contracts all-integer with int32
+    accumulation — the MXU's native int8 path, no weight convert in the
+    operand stream.  The embed / lm_head table keeps the weight-only
+    ``q`` mode (it serves the gather too, and logits set output
+    quality).  Quality cost is measured by utils/quality.py's
+    ``int8_a8`` / ``int4_a8`` modes — activation outliers make these
+    lossier than their weight-only twins; both are opt-in.
 
     The result drops into ``models.transformer.forward`` unchanged —
     ``_project`` / ``embed_inputs`` / ``final_logits`` detect the dict
@@ -154,8 +159,6 @@ def quantize_params(
     """
     if bits not in (4, 8):
         raise ValueError(f"bits must be 4 or 8, got {bits}")
-    if act_quant and bits != 8:
-        raise ValueError("act_quant requires bits=8 (int8×int8 MXU path)")
     qproj = quantize_array4 if bits == 4 else quantize_array
     out = dict(params)
     layers = dict(params["layers"])
@@ -164,8 +167,9 @@ def quantize_params(
             # stacked [L, in, out] (dense) or [L, E, in, out] (MoE experts):
             # contraction axis is always -2
             w = qproj(layers[key], axis=-2)
-            if act_quant:
-                w = {"qa": w.pop("q"), **w}
+            if act_quant:  # W8A8 "qa" / W4A8 "q4a": int-MXU consumption
+                pk = "q" if "q" in w else "q4"
+                w = {pk + "a": w.pop(pk), **w}
             layers[key] = w
     out["layers"] = layers
     if embed:
@@ -221,7 +225,9 @@ def quant_einsum(spec: str, x: jnp.ndarray, w: Any) -> jnp.ndarray:
     this."""
     if not is_quantized(w):
         return jnp.einsum(spec, x, w, preferred_element_type=jnp.float32)
-    if "qa" in w:
+    if "qa" in w or "q4a" in w:
+        # dynamic activation quant (per-row absmax over the contracted
+        # axes), then an all-integer contraction on the MXU's int8 path
         ins, out = spec.replace(" ", "").split("->")
         x_idx, _ = ins.split(",")
         contracted = tuple(i for i, c in enumerate(x_idx) if c not in out)
@@ -232,10 +238,15 @@ def quant_einsum(spec: str, x: jnp.ndarray, w: Any) -> jnp.ndarray:
         xq = jnp.clip(jnp.round(x.astype(jnp.float32) / sx), -127, 127).astype(
             jnp.int8
         )
-        y = jnp.einsum(
-            spec, xq, w["qa"], preferred_element_type=jnp.int32
-        ).astype(jnp.float32)
-        return y * _align_x_scale(spec, sx) * _align_scale(spec, w["s"])
+        if "qa" in w:
+            y = jnp.einsum(spec, xq, w["qa"], preferred_element_type=jnp.int32)
+        else:
+            y = _einsum4(spec, xq, w["q4a"], int_accum=True)
+        return (
+            y.astype(jnp.float32)
+            * _align_x_scale(spec, sx)
+            * _align_scale(spec, w["s"])
+        )
     if "q4" in w:
         y = _einsum4(spec, x, w["q4"])
     else:
@@ -245,13 +256,19 @@ def quant_einsum(spec: str, x: jnp.ndarray, w: Any) -> jnp.ndarray:
     return y * _align_scale(spec, w["s"])
 
 
-def _einsum4(spec: str, x: jnp.ndarray, q4: jnp.ndarray) -> jnp.ndarray:
+def _einsum4(
+    spec: str, x: jnp.ndarray, q4: jnp.ndarray, *, int_accum: bool = False
+) -> jnp.ndarray:
     """int4 einsum that contracts over (packed-pair, nibble) axes
     directly: x's contraction axis splits [in] → [in/2, 2] (a free
     adjacent-dim reshape on the ACTIVATION, which is tiny at decode) and
     the weight unpacks as [..., in/2, 2, out] via _unpack4_pairs — no
     axis-merge reshape on the weight side, keeping the whole decode
-    elementwise-fusable into the GEMM operand read."""
+    elementwise-fusable into the GEMM operand read.
+
+    ``int_accum=True`` (W4A8: x already int8) keeps the unpacked nibbles
+    int8 and accumulates in int32 — all-integer MXU contraction."""
+    acc = jnp.int32 if int_accum else jnp.float32
     ins, out = spec.replace(" ", "").split("->")
     x_idx, w_idx = ins.split(",")
     c = w_idx[-2]  # quantize_array4 packs along axis -2 only
@@ -260,13 +277,13 @@ def _einsum4(spec: str, x: jnp.ndarray, q4: jnp.ndarray) -> jnp.ndarray:
         # back to the explicit unpack
         return jnp.einsum(
             spec, x, _unpack4(q4).astype(x.dtype),
-            preferred_element_type=jnp.float32,
+            preferred_element_type=acc,
         )
     n = next(ch for ch in "nmzyxwutsr" if ch not in spec)
     xr = x.reshape(*x.shape[:-1], x.shape[-1] // 2, 2)
     u = _unpack4_pairs(q4).astype(x.dtype)
     pair_spec = f"{x_idx[:-1]}{c}{n},{w_idx[:-1]}{n}{w_idx[-1]}->{out}"
-    return jnp.einsum(pair_spec, xr, u, preferred_element_type=jnp.float32)
+    return jnp.einsum(pair_spec, xr, u, preferred_element_type=acc)
 
 
 def param_bytes(params: Params) -> int:

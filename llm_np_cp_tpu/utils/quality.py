@@ -21,7 +21,7 @@ from llm_np_cp_tpu.models.transformer import forward
 from llm_np_cp_tpu.ops.sampling import Sampler
 from llm_np_cp_tpu.quant import quantize_params
 
-MODES = ("int8", "int8_a8", "int4", "kv_int8")
+MODES = ("int8", "int8_a8", "int4", "int4_a8", "kv_int8")
 
 
 def quant_quality(
@@ -50,8 +50,8 @@ def quant_quality(
         qparams, cache_dtype = params, jnp.int8
     else:
         qparams = quantize_params(
-            params, bits=4 if mode == "int4" else 8,
-            act_quant=mode == "int8_a8",
+            params, bits=4 if mode.startswith("int4") else 8,
+            act_quant=mode.endswith("_a8"),
         )
         cache_dtype = base_dtype
     quant = Generator(qparams, config, sampler=sampler, cache_dtype=cache_dtype)

@@ -148,13 +148,23 @@ def test_w8a8_sharded_generation_runs():
     assert np.all(res.tokens >= 0)
 
 
-def test_w8a8_requires_8_bits():
+def test_w4a8_forward_tracks_float():
+    """W4A8 (q4a: packed int4 weights × int8 activations, int32
+    accumulation) tracks the float forward at int4-class error."""
     cfg = tiny_config("llama")
-    params = init_params(jax.random.PRNGKey(7), cfg, dtype=jnp.float32)
-    import pytest
+    params = init_params(jax.random.PRNGKey(8), cfg, dtype=jnp.float32)
+    qparams = quantize_params(params, bits=4, act_quant=True)
+    assert "q4a" in qparams["layers"]["q_proj"]
 
-    with pytest.raises(ValueError, match="act_quant requires bits=8"):
-        quantize_params(params, bits=4, act_quant=True)
+    ids = jnp.asarray(
+        np.random.default_rng(8).integers(0, cfg.vocab_size, (2, 12)), jnp.int32
+    )
+    ref, _ = forward(params, ids, cfg, None)
+    got, _ = forward(qparams, ids, cfg, None)
+    ref, got = np.asarray(ref), np.asarray(got)
+    scale = np.abs(ref).max()
+    assert np.abs(got - ref).max() < 0.2 * scale
+    assert (ref.argmax(-1) == got.argmax(-1)).mean() > 0.75
 
 
 def test_param_bytes_shrink():

@@ -25,6 +25,7 @@ FLOORS = {
     "int8": (96, 0.01, 0.08),
     "int8_a8": (96, 0.01, 0.08),
     "int4": (32, 0.10, 0.80),
+    "int4_a8": (32, 0.10, 0.80),
     "kv_int8": (96, 0.005, 0.03),
 }
 
