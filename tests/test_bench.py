@@ -95,8 +95,7 @@ def test_warm_smoke_offline():
     assert res.get("ok") is True, res
     assert set(res["warmed"]) == {n for n in bench.PRIORITY
                                  if n not in bench.SPEC_CONFIGS
-                                 and n not in bench.EXTRA_CHILDREN
-                                 and n not in bench.RAGGED_CONFIGS}
+                                 and n not in bench.EXTRA_CHILDREN}
 
 
 def test_ragged_smoke_offline():
