@@ -1,0 +1,92 @@
+"""tools/merge_live.py invariants.
+
+The merge tool assembles the round's durable perf artifact from retry
+windows; a regression here corrupts the evidence of record (ADVICE r4:
+the r4 artifact was hand-merged and internally inconsistent).
+"""
+
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+TOOL = Path(__file__).resolve().parent.parent / "tools" / "merge_live.py"
+
+
+def _run(art: Path, *sources: Path):
+    return subprocess.run(
+        [sys.executable, str(TOOL), str(art)] + [str(s) for s in sources],
+        capture_output=True, text=True, check=True,
+    )
+
+
+def _write(p: Path, obj) -> Path:
+    p.write_text(json.dumps(obj) + "\n")
+    return p
+
+
+def test_failed_retry_cannot_overwrite_ok_row(tmp_path):
+    art = tmp_path / "art.json"
+    good = _write(tmp_path / "good.out", {
+        "metric": "m", "value": 1629.3, "detail": {
+            "llama1b_bs8": {"ok": True, "decode_tok_s_chip": 1629.3},
+        },
+    })
+    bad = _write(tmp_path / "bad.out", {
+        "config": "llama1b_bs8", "ok": False, "error": "timeout",
+    })
+    _run(art, good, bad)
+    a = json.loads(art.read_text())
+    assert a["detail"]["llama1b_bs8"]["ok"] is True
+    assert a["value"] == 1629.3
+
+
+def test_evidence_children_merge_even_failed(tmp_path):
+    art = tmp_path / "art.json"
+    kern = _write(tmp_path / "k.out", {
+        "config": "kernels", "ok": False, "softmax": "FAIL: x",
+    })
+    _run(art, kern)
+    a = json.loads(art.read_text())
+    # raw-child seeding keeps the summary artifact shape
+    assert a["metric"] == "decode_tokens_per_sec_per_chip"
+    assert a["detail"]["kernels"]["ok"] is False
+
+
+def test_provenance_appends_per_source_and_banner_idempotent(tmp_path):
+    art = tmp_path / "art.json"
+    down = _write(tmp_path / "down.out", {
+        "metric": "m", "value": 0.0, "error": "TPU backend unreachable: x",
+        "detail": {"probe": {"ok": False}},
+    })
+    up = _write(tmp_path / "up.out", {
+        "metric": "m", "value": 5.0, "detail": {
+            "llama1b_bs8": {"ok": True, "decode_tok_s_chip": 2000.0},
+        },
+    })
+    _run(art, down)
+    _run(art, up)
+    _run(art, up)  # repeated merge must not stack the banner
+    a = json.loads(art.read_text())
+    assert a["value"] == 2000.0
+    assert a["error"].count("(superseded by merge)") == 1
+    prov = a["detail"]["merge_provenance"]
+    assert len(prov) == 3
+    assert prov[1]["merged"] == ["llama1b_bs8"]
+
+
+def test_seed_provenance_lists_only_mergeable_rows(tmp_path):
+    art = tmp_path / "art.json"
+    summary = _write(tmp_path / "s.out", {
+        "metric": "m", "value": 1.0, "detail": {
+            "llama1b_bs8": {"ok": True, "decode_tok_s_chip": 1.0},
+            "broken": {"ok": False, "error": "x"},
+            "quality": {"ok": True},
+            "headline_definition": "a string, not a row",
+        },
+    })
+    _run(art, summary)
+    a = json.loads(art.read_text())
+    assert a["detail"]["merge_provenance"][0]["merged"] == [
+        "llama1b_bs8", "quality"
+    ]
