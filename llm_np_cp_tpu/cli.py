@@ -104,12 +104,9 @@ def run(argv: list[str] | None = None, default_model: str = "meta-llama/Llama-3.
             "--prompts-file batches through the tpu Generator; the numpy "
             "oracle and --speculative pipelines are single-prompt"
         )
-    if args.prompts_file and args.prefill_chunk:
-        raise SystemExit(
-            "--prompts-file (ragged left-padded batch) and --prefill-chunk "
-            "are mutually exclusive: chunked prefill requires dense "
-            "same-length rows"
-        )
+    # --prompts-file composes with --prefill-chunk: ragged chunks slice
+    # the pad mask per chunk and the cache bitmap persists validity
+    # (generate.make_chunked_prefill_fn ragged_step)
     if args.prompts_file and (args.attn_impl in ("flash", "ring") or args.flash_prefill):
         raise SystemExit(
             "--prompts-file uses ragged pad masks, which the flash/ring "

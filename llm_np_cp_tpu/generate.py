@@ -139,6 +139,22 @@ def make_chunked_prefill_fn(
     chunk_step = _make_step("xla")
     first_step = chunk_step if attn_impl == "xla" else _make_step(attn_impl)
 
+    # Ragged (left-padded) chunks: the cache's validity bitmap persists
+    # pad slots masked in earlier chunks (models/transformer.py), and
+    # positions derive from the running cache offset minus pad_offsets —
+    # so a chunk-sliced attn_mask composes exactly with chunking.  A
+    # separate jitted step so the dense program keeps its shape.
+    @partial(jax.jit, donate_argnums=(2,))
+    def ragged_step(
+        params: Params, ids: jnp.ndarray, cache: KVCache,
+        mask: jnp.ndarray, pads: jnp.ndarray,
+    ):
+        logits, cache = forward(
+            params, ids, config, cache, logits_last_only=True,
+            attn_mask=mask, pad_offsets=pads, attn_impl="xla",
+        )
+        return logits[:, -1], cache
+
     def prefill_chunked(
         params: Params,
         prompt_ids: jnp.ndarray,
@@ -147,16 +163,29 @@ def make_chunked_prefill_fn(
         attn_mask: jnp.ndarray | None = None,
         pad_offsets: jnp.ndarray | None = None,
     ):
-        if attn_mask is not None or pad_offsets is not None:
+        ragged = attn_mask is not None or pad_offsets is not None
+        if ragged and (attn_mask is None or pad_offsets is None):
             raise ValueError(
-                "chunked prefill does not support ragged batches "
-                "(attn_mask/pad_offsets); use the one-shot prefill"
+                "ragged chunked prefill needs BOTH attn_mask and pad_offsets"
+            )
+        if ragged and attn_impl != "xla":
+            # same contract as the one-shot path: flash/ring masks are
+            # slot-index-based and cannot see per-row pads
+            raise ValueError(
+                f"attn_impl={attn_impl!r} does not support ragged batches; "
+                "use attn_impl='xla'"
             )
         s = prompt_ids.shape[1]
         off, step, last = 0, first_step, None
         while off < s:
             w = min(chunk_size, s - off)
-            last, cache = step(params, prompt_ids[:, off:off + w], cache)
+            if ragged:
+                last, cache = ragged_step(
+                    params, prompt_ids[:, off:off + w], cache,
+                    attn_mask[:, off:off + w], pad_offsets,
+                )
+            else:
+                last, cache = step(params, prompt_ids[:, off:off + w], cache)
             step, off = chunk_step, off + w
         tok = sampler(key, last)
         return tok, cache, last
@@ -166,6 +195,7 @@ def make_chunked_prefill_fn(
     # at the chunk shape is a different program and misses the cache)
     prefill_chunked.chunk_step = chunk_step
     prefill_chunked.first_step = first_step
+    prefill_chunked.ragged_step = ragged_step
     return prefill_chunked
 
 

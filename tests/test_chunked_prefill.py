@@ -126,3 +126,33 @@ def test_chunked_rejects_ragged(model):
             params, ids, KVCache.init(config, 2, 32, dtype=jnp.float32),
             jax.random.PRNGKey(0), jnp.ones(ids.shape, bool), None,
         )
+
+
+@pytest.mark.parametrize("chunk", [1, 3, 7, 64])
+def test_ragged_chunked_matches_oneshot_ragged(model, chunk):
+    """Left-padded ragged batch through chunked prefill == one-shot
+    ragged generation, token for token (the chunk-sliced pad mask +
+    persisted cache validity bitmap keep every row exact)."""
+    config, params = model
+    prompts = [
+        np.arange(17, dtype=np.int32) % config.vocab_size,
+        np.arange(9, dtype=np.int32) % config.vocab_size + 3,
+        np.arange(2, dtype=np.int32) % config.vocab_size + 7,
+    ]
+    one = Generator(params, config, sampler=Sampler(kind="greedy"),
+                    cache_dtype=jnp.float32)
+    chk = Generator(params, config, sampler=Sampler(kind="greedy"),
+                    cache_dtype=jnp.float32, prefill_chunk=chunk)
+    want = one.generate_ragged(prompts, 8)
+    got = chk.generate_ragged(prompts, 8)
+    np.testing.assert_array_equal(np.asarray(got.tokens), np.asarray(want.tokens))
+
+
+def test_ragged_chunked_rejects_flash_impl(model):
+    config, params = model
+    gen = Generator(params, config, sampler=Sampler(kind="greedy"),
+                    cache_dtype=jnp.float32, prefill_chunk=4,
+                    prefill_attn_impl="flash")
+    prompts = [np.arange(5, dtype=np.int32), np.arange(3, dtype=np.int32)]
+    with pytest.raises(ValueError, match="ragged"):
+        gen.generate_ragged(prompts, 4)

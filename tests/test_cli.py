@@ -331,8 +331,18 @@ def test_cli_prompts_file_rejects_numpy_and_spec(fake_load, tmp_path):
         cli.run(["--backend=tpu", "--speculative=2", f"--prompts-file={pf}"])
 
 
-def test_cli_prompts_file_rejects_prefill_chunk(fake_load, tmp_path):
+def test_cli_prompts_file_composes_with_prefill_chunk(fake_load, tmp_path):
+    """Ragged batch through chunked prefill == one-shot ragged (the pad
+    mask slices per chunk; the cache bitmap persists validity)."""
+    prompts = ["hi", "hello", "hello wo"]
     pf = tmp_path / "p.txt"
-    pf.write_text("hello\n")
-    with pytest.raises(SystemExit, match="mutually exclusive"):
-        cli.run(["--backend=tpu", "--prefill-chunk=4", f"--prompts-file={pf}"])
+    pf.write_text("\n".join(prompts) + "\n")
+    oneshot = cli.run([
+        "--backend=tpu", "--sampler=greedy", "--max-tokens=5",
+        "--dtype=f32", f"--prompts-file={pf}",
+    ])
+    chunked = cli.run([
+        "--backend=tpu", "--sampler=greedy", "--max-tokens=5",
+        "--dtype=f32", f"--prompts-file={pf}", "--prefill-chunk=3",
+    ])
+    assert chunked == oneshot
