@@ -344,3 +344,29 @@ def test_int4_einsum_moe_specs_match_dequantized():
     want = jnp.einsum("geci,eih->gech", xd, dequantize(qwd))
     got = quant_einsum("geci,eih->gech", xd, qwd)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-5)
+
+
+def test_act_quant_einsum_moe_specs_track_dequantized():
+    """The qa / q4a (dynamic activation quant, all-integer contraction)
+    paths on the stacked-expert MoE specs: output tracks the float
+    contraction within the activation-quant error bound."""
+    from llm_np_cp_tpu.quant import quant_einsum, quantize_array, quantize_array4
+
+    rng = np.random.default_rng(6)
+    for spec, xs, ws in (
+        ("gech,ehi->geci", (2, 3, 4, 16), (3, 16, 10)),
+        ("geci,eih->gech", (2, 3, 4, 10), (3, 10, 16)),
+        ("bsh,ho->bso", (2, 3, 16), (16, 10)),
+    ):
+        x = jnp.asarray(rng.normal(size=xs), jnp.float32)
+        w = jnp.asarray(rng.normal(size=ws) * 0.2, jnp.float32)
+        want = np.einsum(spec, np.asarray(x), np.asarray(w))
+        scale = np.abs(want).max()
+
+        q8 = quantize_array(w, axis=-2)
+        got8 = quant_einsum(spec, x, {"qa": q8["q"], "s": q8["s"]})
+        assert np.abs(np.asarray(got8) - want).max() < 0.03 * scale, spec
+
+        q4 = quantize_array4(w, axis=-2)
+        got4 = quant_einsum(spec, x, {"q4a": q4["q4"], "s": q4["s"]})
+        assert np.abs(np.asarray(got4) - want).max() < 0.15 * scale, spec
