@@ -307,6 +307,21 @@ GEMMA_2_9B = dataclasses.replace(
     head_dim=256,
 )
 
+# The one published Gemma-2 size where query_pre_attn_scalar (hidden /
+# num_heads = 4608/32 = 144) differs from head_dim (128) — the scaling
+# delta the reference computes and then ignores (gemma2_model.py:434 vs
+# :541-543); we apply it, so this preset exercises the correct path.
+GEMMA_2_27B = dataclasses.replace(
+    GEMMA_2_2B,
+    hidden_size=4608,
+    intermediate_size=36864,
+    num_hidden_layers=46,
+    num_attention_heads=32,
+    num_key_value_heads=16,
+    head_dim=128,
+    query_pre_attn_scalar=144.0,
+)
+
 QWEN_2_5_0_5B = ModelConfig(
     model_type="qwen2",
     vocab_size=151936,
@@ -340,6 +355,7 @@ PRESETS: dict[str, ModelConfig] = {
     "meta-llama/Llama-3.1-8B": LLAMA_3_1_8B,
     "google/gemma-2-2b": GEMMA_2_2B,
     "google/gemma-2-9b": GEMMA_2_9B,
+    "google/gemma-2-27b": GEMMA_2_27B,
     "Qwen/Qwen2.5-0.5B": QWEN_2_5_0_5B,
     "Qwen/Qwen2.5-1.5B": QWEN_2_5_1_5B,
 }

@@ -1,0 +1,66 @@
+"""Config presets and derived-field rules.
+
+Presets mirror the published HF config.json values; the derived fields
+(attn_scale, num_query_groups, o_proj_bias) encode behavior the
+reference gets wrong or drops (SURVEY §2.7), so they are pinned here.
+"""
+
+from llm_np_cp_tpu.config import (
+    GEMMA_2_2B,
+    GEMMA_2_27B,
+    LLAMA_3_2_1B,
+    PRESETS,
+    ModelConfig,
+    QWEN_2_5_0_5B,
+)
+
+
+def test_all_presets_construct_and_divide():
+    for name, cfg in PRESETS.items():
+        assert cfg.num_attention_heads % cfg.num_key_value_heads == 0, name
+        assert cfg.vocab_size > 0 and cfg.num_hidden_layers > 0, name
+
+
+def test_attn_scale_rules():
+    # Llama: 1/sqrt(head_dim)
+    assert LLAMA_3_2_1B.attn_scale == LLAMA_3_2_1B.head_dim ** -0.5
+    # Gemma-2-2B: query_pre_attn_scalar == head_dim == 256 → same value
+    assert GEMMA_2_2B.attn_scale == 256.0 ** -0.5
+    # Gemma-2-27B: scalar (144) ≠ head_dim (128) — the size where applying
+    # query_pre_attn_scalar (which the reference ignores) actually matters
+    assert GEMMA_2_27B.attn_scale == 144.0 ** -0.5
+    assert GEMMA_2_27B.attn_scale != GEMMA_2_27B.head_dim ** -0.5
+
+
+def test_qwen_bias_pattern():
+    # Q/K/V biased, o_proj not (HF Qwen2Attention)
+    assert QWEN_2_5_0_5B.attention_bias is True
+    assert QWEN_2_5_0_5B.o_proj_bias is False
+
+
+def test_from_hf_dict_gemma27b_scalar():
+    cfg = ModelConfig.from_hf_dict({
+        "model_type": "gemma2",
+        "vocab_size": 256000,
+        "hidden_size": 4608,
+        "intermediate_size": 36864,
+        "num_hidden_layers": 46,
+        "num_attention_heads": 32,
+        "num_key_value_heads": 16,
+        "head_dim": 128,
+        "query_pre_attn_scalar": 144.0,
+        "sliding_window": 4096,
+        "final_logit_softcapping": 30.0,
+        "attn_logit_softcapping": 50.0,
+    })
+    assert cfg.attn_scale == GEMMA_2_27B.attn_scale
+    assert cfg.sandwich_norms and cfg.rms_norm_unit_offset
+
+
+def test_scan_unroll_in_jit_key():
+    import dataclasses
+
+    a = LLAMA_3_2_1B
+    b = dataclasses.replace(a, scan_unroll=2)
+    # distinct hashable configs → distinct jit cache entries (ADVICE r4)
+    assert a != b and hash(a) != hash(b)
