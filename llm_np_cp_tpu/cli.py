@@ -99,10 +99,10 @@ def build_parser(default_model: str) -> argparse.ArgumentParser:
 def run(argv: list[str] | None = None, default_model: str = "meta-llama/Llama-3.2-1B") -> str:
     args = build_parser(default_model).parse_args(argv)
     _validate_draft(args)
-    if args.prompts_file and (args.backend == "numpy" or args.speculative > 0):
+    if args.prompts_file and args.backend == "numpy":
         raise SystemExit(
-            "--prompts-file batches through the tpu Generator; the numpy "
-            "oracle and --speculative pipelines are single-prompt"
+            "--prompts-file batches through the tpu backend; the numpy "
+            "oracle is single-prompt"
         )
     # --prompts-file composes with --prefill-chunk: ragged chunks slice
     # the pad mask per chunk and the cache bitmap persists validity
@@ -323,6 +323,18 @@ def _run_tpu(args) -> str:
 
     ctx = jax.set_mesh(mesh) if mesh is not None else contextlib.nullcontext()
 
+    # one definition of prompts-file parsing for BOTH pipelines below
+    batch_prompt_ids = None
+    if args.prompts_file:
+        with open(args.prompts_file) as f:
+            prompts = [line.rstrip("\n") for line in f if line.strip()]
+        if not prompts:
+            raise SystemExit(f"--prompts-file {args.prompts_file}: no prompts")
+        batch_prompt_ids = [
+            tok(p, return_tensors="np")["input_ids"][0].astype(np.int32)
+            for p in prompts
+        ]
+
     if args.speculative > 0:
         from llm_np_cp_tpu.speculative import SpeculativeGenerator
 
@@ -336,10 +348,33 @@ def _run_tpu(args) -> str:
                 cache_dtype=cache_dtype, prefill_chunk=args.prefill_chunk,
                 **_draft_kwargs(args.draft, params, config),
             )
+            stops = (eos,) if eos is not None else ()
+            if batch_prompt_ids is not None:
+                res = spec.generate_ragged(
+                    batch_prompt_ids, args.max_tokens,
+                    max_seq_len=args.max_seq_len, seed=args.seed,
+                    stop_tokens=stops,
+                )
+                texts = [
+                    tok.decode(row, skip_special_tokens=True)
+                    for row in np.asarray(res.tokens)
+                ]
+                for text in texts:
+                    print(text)
+                if args.metrics:
+                    print(
+                        f"[tpu] speculative ragged batch of {len(texts)} "
+                        f"γ={args.speculative}: {res.decode_tokens_per_s:.1f} "
+                        f"tok/s aggregate, accept {res.acceptance_rate:.2f}, "
+                        f"{res.tokens_per_round:.2f} tok/round, "
+                        f"ttft {res.ttft_s:.3f}s",
+                        file=sys.stderr,
+                    )
+                return "\n".join(texts)
             prompt_ids = tok(args.prompt, return_tensors="np")["input_ids"][0]
             res = spec.generate(
                 prompt_ids, args.max_tokens, seed=args.seed,
-                stop_tokens=(eos,) if eos is not None else (),
+                stop_tokens=stops,
             )
         text = tok.decode(res.tokens, skip_special_tokens=True)
         print(text)
@@ -362,18 +397,10 @@ def _run_tpu(args) -> str:
         decode_attn_impl="flash_decode" if args.decode_attn == "pallas" else "xla",
     )
 
-    if args.prompts_file:
-        with open(args.prompts_file) as f:
-            prompts = [line.rstrip("\n") for line in f if line.strip()]
-        if not prompts:
-            raise SystemExit(f"--prompts-file {args.prompts_file}: no prompts")
-        prompt_ids = [
-            tok(p, return_tensors="np")["input_ids"][0].astype(np.int32)
-            for p in prompts
-        ]
+    if batch_prompt_ids is not None:
         with ctx:
             res = gen.generate_ragged(
-                prompt_ids, args.max_tokens,
+                batch_prompt_ids, args.max_tokens,
                 max_seq_len=args.max_seq_len, seed=args.seed,
             )
         texts, row_counts = [], []

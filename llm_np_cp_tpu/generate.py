@@ -398,6 +398,26 @@ class Generator:
         return self._run_fused(prompt_ids, max_new_tokens, max_seq_len, seed)
 
     # -- ragged batch --------------------------------------------------
+    @staticmethod
+    def left_pad(
+        prompts: list[np.ndarray | list[int]],
+    ) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """The ragged left-pad contract, in ONE place: prompts → (ids
+        [B, S] zero-left-padded, mask [B, S] valid, pads [B] per-row pad
+        counts).  Used by Generator.generate_ragged and
+        SpeculativeGenerator.generate_ragged."""
+        arrs = [np.asarray(p, dtype=np.int32).reshape(-1) for p in prompts]
+        s = max(a.size for a in arrs)
+        b = len(arrs)
+        ids = np.zeros((b, s), dtype=np.int32)
+        mask = np.zeros((b, s), dtype=bool)
+        pads = np.zeros(b, dtype=np.int32)
+        for i, a in enumerate(arrs):
+            pads[i] = s - a.size
+            ids[i, pads[i]:] = a
+            mask[i, pads[i]:] = True
+        return ids, mask, pads
+
     def generate_ragged(
         self,
         prompts: list[np.ndarray | list[int]],
@@ -414,18 +434,7 @@ class Generator:
         invalid in the cache bitmap.  The reference has no batching at all
         (its generate loop is bs=1, llama3.2_model.py:865-902).
         """
-        arrs = [np.asarray(p, dtype=np.int32).reshape(-1) for p in prompts]
-        lens = [a.size for a in arrs]
-        s = max(lens)
-        b = len(arrs)
-        ids = np.zeros((b, s), dtype=np.int32)
-        mask = np.zeros((b, s), dtype=bool)
-        pads = np.zeros(b, dtype=np.int32)
-        for i, a in enumerate(arrs):
-            pads[i] = s - a.size
-            ids[i, pads[i]:] = a
-            mask[i, pads[i]:] = True
-
+        ids, mask, pads = self.left_pad(prompts)
         return self._run_fused(
             jnp.asarray(ids),
             max_new_tokens,

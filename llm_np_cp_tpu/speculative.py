@@ -112,6 +112,7 @@ def _spec_round_core(
     sampler: Sampler,
     draft_sampler: Sampler,
     active: jnp.ndarray | None = None,
+    pad_offsets: jnp.ndarray | None = None,
 ):
     """Traced body of one speculative round, batched over rows.
 
@@ -124,6 +125,10 @@ def _spec_round_core(
     active: optional [B] bool — rows that already finished (hit a stop
     token / budget) are frozen: their count is 0 and their cache rows roll
     back to where they started, so they burn no capacity.
+
+    pad_offsets: optional [B] int32 — per-row LEFT-pad amounts for ragged
+    batches (generate_ragged); threaded into every forward so RoPE
+    positions and causal masks stay row-exact.
 
     Returns (emitted [B, γ+1] (first count_b real per row), count [B],
     dcache, tcache, next_t0 [B]).
@@ -141,7 +146,8 @@ def _spec_round_core(
     def dstep(carry, k):
         tok, dc = carry
         logits, dc = forward(
-            draft_params, tok[:, None], draft_config, dc, logits_last_only=True
+            draft_params, tok[:, None], draft_config, dc, logits_last_only=True,
+            pad_offsets=pad_offsets,
         )
         fl = draft_sampler.filtered_logits(logits[:, -1])  # [B, V]
         nxt = jax.random.categorical(k, fl, axis=-1).astype(jnp.int32)
@@ -154,7 +160,9 @@ def _spec_round_core(
 
     # --- target: verify all proposals in one forward
     inp = jnp.concatenate([t0[:, None], d], axis=1)  # [B, γ+1]
-    tlogits, tcache2 = forward(target_params, inp, target_config, tcache)
+    tlogits, tcache2 = forward(
+        target_params, inp, target_config, tcache, pad_offsets=pad_offsets
+    )
     p = jax.nn.softmax(sampler.filtered_logits(tlogits), axis=-1)  # [B, γ+1, V]
 
     # --- accept/reject (multiplied form avoids div-by-zero; q(d) > 0
@@ -260,6 +268,7 @@ def make_spec_decode_fn(
         tcache: KVCache,
         key: jax.Array,
         max_new: int,
+        pad_offsets: jnp.ndarray | None = None,
     ):
         b = t0.shape[0]
         # per-row lengths from round one, so the while-carry type is stable
@@ -297,7 +306,7 @@ def make_spec_decode_fn(
                 draft_params, target_params, t, dcache, tcache, kr,
                 draft_config=draft_config, target_config=target_config,
                 gamma=gamma, sampler=sampler, draft_sampler=draft_sampler_,
-                active=active,
+                active=active, pad_offsets=pad_offsets,
             )
             # write the whole γ+1 window at each row's total; slots past
             # `count_b` are garbage overwritten next round (buf is oversized
@@ -405,6 +414,48 @@ class SpeculativeGenerator:
         squeeze = prompt_ids.ndim == 1
         if squeeze:
             prompt_ids = prompt_ids[None, :]
+        return self._run(
+            prompt_ids, max_new_tokens, max_seq_len, seed, stop_tokens,
+            squeeze=squeeze,
+        )
+
+    def generate_ragged(
+        self,
+        prompts: list[np.ndarray | list[int]],
+        max_new_tokens: int,
+        *,
+        max_seq_len: int | None = None,
+        seed: int = 0,
+        stop_tokens: tuple[int, ...] = (),
+    ) -> SpecResult:
+        """Speculative generation over prompts of different lengths.
+
+        Same left-pad contract as Generator.generate_ragged: rows pad on
+        the LEFT, per-row ``pad_offsets`` keep RoPE positions and masks
+        exact through every draft/verify forward, and the per-row cache
+        lengths the accept/rollback machinery already uses handle the
+        rest — each row behaves as if it ran alone (verified in tests).
+        """
+        from llm_np_cp_tpu.generate import Generator
+
+        ids, mask, pads = Generator.left_pad(prompts)
+        return self._run(
+            jnp.asarray(ids), max_new_tokens, max_seq_len, seed, stop_tokens,
+            attn_mask=jnp.asarray(mask), pad_offsets=jnp.asarray(pads),
+        )
+
+    def _run(
+        self,
+        prompt_ids: jnp.ndarray,
+        max_new_tokens: int,
+        max_seq_len: int | None,
+        seed: int,
+        stop_tokens: tuple[int, ...],
+        *,
+        attn_mask: jnp.ndarray | None = None,
+        pad_offsets: jnp.ndarray | None = None,
+        squeeze: bool = False,
+    ) -> SpecResult:
         b, s = prompt_ids.shape
         # rounds overshoot by up to γ+1 tokens before rollback trims them
         max_seq_len = max_seq_len or s + max_new_tokens + self.gamma + 1
@@ -420,8 +471,12 @@ class SpeculativeGenerator:
         dcache = KVCache.init(self.draft_config, b, max_seq_len, dtype=self.cache_dtype)
 
         t0_wall = time.perf_counter()
-        tok, tcache, _ = self._prefill_t(self.params, prompt_ids, tcache, kp)
-        _, dcache, _ = self._prefill_d(self.draft_params, prompt_ids, dcache, kp)
+        tok, tcache, _ = self._prefill_t(
+            self.params, prompt_ids, tcache, kp, attn_mask, pad_offsets
+        )
+        _, dcache, _ = self._prefill_d(
+            self.draft_params, prompt_ids, dcache, kp, attn_mask, pad_offsets
+        )
         # force BOTH prefills (draft included) so its cost lands in TTFT,
         # not in the decode timer
         np.asarray(tok)
@@ -434,7 +489,7 @@ class SpeculativeGenerator:
             stop_tokens
         )(
             self.draft_params, self.params, tok, dcache, tcache, key,
-            max_new_tokens,
+            max_new_tokens, pad_offsets,
         )
         buf = np.asarray(buf)  # forces completion (D2H)
         decode_s = time.perf_counter() - t_dec

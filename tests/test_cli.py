@@ -322,13 +322,30 @@ def test_cli_prompts_file_matches_single_runs(fake_load, capsys, tmp_path):
     assert rows == singles
 
 
-def test_cli_prompts_file_rejects_numpy_and_spec(fake_load, tmp_path):
+def test_cli_prompts_file_rejects_numpy(fake_load, tmp_path):
     pf = tmp_path / "p.txt"
     pf.write_text("hello\n")
     with pytest.raises(SystemExit):
         cli.run(["--backend=numpy", f"--prompts-file={pf}"])
-    with pytest.raises(SystemExit):
-        cli.run(["--backend=tpu", "--speculative=2", f"--prompts-file={pf}"])
+
+
+def test_cli_prompts_file_composes_with_speculative(fake_load, capsys, tmp_path):
+    """--prompts-file + --speculative: ragged speculation emits the same
+    rows as plain ragged greedy generation (losslessness, batched)."""
+    prompts = ["hi", "hello", "hello wo"]
+    pf = tmp_path / "p.txt"
+    pf.write_text("\n".join(prompts) + "\n")
+    want = cli.run([
+        "--backend=tpu", "--sampler=greedy", "--max-tokens=5",
+        "--dtype=f32", f"--prompts-file={pf}",
+    ])
+    got = cli.run([
+        "--backend=tpu", "--sampler=greedy", "--max-tokens=5",
+        "--dtype=f32", f"--prompts-file={pf}", "--speculative=2",
+        "--metrics",
+    ])
+    assert got == want
+    assert "speculative ragged batch of 3" in capsys.readouterr().err
 
 
 def test_cli_prompts_file_composes_with_prefill_chunk(fake_load, tmp_path):

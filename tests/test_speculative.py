@@ -273,3 +273,38 @@ def test_batched_spec_acceptance_counts_active_rows_only():
     res = spec.generate(prompts, 21)
     assert res.acceptance_rate == 1.0  # perfect draft, every active row
     assert res.rounds == 4
+
+
+def test_ragged_spec_matches_solo_rows():
+    """Ragged speculative batch (left-padded, per-row pad_offsets) must
+    emit exactly what each row emits spec'd alone — positions, masks,
+    rollbacks and acceptance are all row-exact."""
+    target = _params(7)
+    prompts = [_prompt(10, n=9), _prompt(11, n=5), _prompt(12, n=2)]
+    spec = SpeculativeGenerator(
+        target, CFG, gamma=3, sampler=Sampler(kind="greedy"),
+        cache_dtype=jnp.float32,
+    )
+    batched = spec.generate_ragged(prompts, 12)
+    assert batched.tokens.shape == (3, 12)
+    for i, p in enumerate(prompts):
+        solo = spec.generate(p, 12)
+        np.testing.assert_array_equal(
+            batched.tokens[i], np.asarray(solo.tokens), err_msg=f"row {i}"
+        )
+
+
+def test_ragged_spec_equals_plain_ragged_greedy():
+    """Greedy ragged speculation == Generator.generate_ragged greedy
+    (losslessness holds under ragged batching too)."""
+    target = _params(9)
+    prompts = [_prompt(13, n=7), _prompt(14, n=3)]
+    plain = Generator(target, CFG, sampler=Sampler(kind="greedy"),
+                      cache_dtype=jnp.float32)
+    want = np.asarray(plain.generate_ragged(prompts, 10).tokens)
+    spec = SpeculativeGenerator(
+        target, CFG, gamma=2, sampler=Sampler(kind="greedy"),
+        cache_dtype=jnp.float32,
+    )
+    got = spec.generate_ragged(prompts, 10).tokens
+    np.testing.assert_array_equal(got, want)
