@@ -308,3 +308,21 @@ def test_ragged_spec_equals_plain_ragged_greedy():
     )
     got = spec.generate_ragged(prompts, 10).tokens
     np.testing.assert_array_equal(got, want)
+
+
+def test_ragged_spec_with_chunked_prefill():
+    """The full composition: ragged batch × speculation × chunked
+    prefill — chunk-sliced pad masks feed both caches' prefills."""
+    target = _params(15)
+    prompts = [_prompt(16, n=9), _prompt(17, n=4)]
+    spec = SpeculativeGenerator(
+        target, CFG, gamma=2, sampler=Sampler(kind="greedy"),
+        cache_dtype=jnp.float32,
+    )
+    want = spec.generate_ragged(prompts, 10).tokens
+    chk = SpeculativeGenerator(
+        target, CFG, gamma=2, sampler=Sampler(kind="greedy"),
+        cache_dtype=jnp.float32, prefill_chunk=3,
+    )
+    got = chk.generate_ragged(prompts, 10).tokens
+    np.testing.assert_array_equal(got, want)
