@@ -91,6 +91,10 @@ def build_parser(default_model: str) -> argparse.ArgumentParser:
                         "int4 self-quantization, or truncN / truncN_int4 — "
                         "a layer-skip draft from the target's first N "
                         "layers (e.g. trunc8_int4)")
+    p.add_argument("--early-stop", action="store_true",
+                   help="fused decode exits once every row has hit EOS "
+                        "(lax.while_loop) instead of running the full "
+                        "token budget; needs a tokenizer EOS")
     p.add_argument("--metrics", action="store_true",
                    help="print tokens/sec and TTFT after generation")
     return p
@@ -387,6 +391,8 @@ def _run_tpu(args) -> str:
                 file=sys.stderr,
             )
         return text
+    if args.early_stop and eos is None:
+        raise SystemExit("--early-stop needs a tokenizer with an EOS token")
     gen = Generator(
         params, config,
         sampler=sampler,
@@ -395,6 +401,7 @@ def _run_tpu(args) -> str:
         prefill_attn_impl=attn_impl,
         prefill_chunk=args.prefill_chunk,
         decode_attn_impl="flash_decode" if args.decode_attn == "pallas" else "xla",
+        early_stop=args.early_stop,
     )
 
     if batch_prompt_ids is not None:

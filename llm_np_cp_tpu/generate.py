@@ -221,6 +221,7 @@ def make_decode_loop_fn(
     sampler: Sampler,
     stop_tokens: tuple[int, ...] = (),
     attn_impl: str = "xla",
+    early_stop: bool = False,
 ) -> Callable:
     """(params, first_tok, cache, key, num_steps) → (tokens [B, steps], cache).
 
@@ -230,8 +231,18 @@ def make_decode_loop_fn(
     caller trims) — branchless, so the scan stays a single fused program.
     attn_impl="flash_decode" routes each step's attention through the
     fused Pallas decode kernel (benchmark-gated; default XLA).
+
+    early_stop=True (requires stop_tokens) swaps the scan for a
+    ``lax.while_loop`` that exits once EVERY row is done — a batch whose
+    rows all hit EOS early stops paying weight-stream steps for tokens
+    nobody will read.  Unfilled tail slots hold 0 and every caller
+    normalizes through ``_trim_after_stop``, so outputs are identical to
+    the scan path (pinned in tests).  Opt-in: a fixed-trip scan is the
+    better program when generation usually runs to the budget.
     """
     stops = jnp.asarray(stop_tokens, dtype=jnp.int32) if stop_tokens else None
+    if early_stop and stops is None:
+        raise ValueError("early_stop requires stop_tokens")
 
     @partial(jax.jit, static_argnums=(4,), donate_argnums=(2,))
     def decode_loop(
@@ -242,10 +253,7 @@ def make_decode_loop_fn(
         num_steps: int,
         pad_offsets: jnp.ndarray | None = None,
     ):
-        keys = jax.random.split(key, num_steps)
-
-        def body(carry, k):
-            tok, cache, done = carry
+        def step(tok, cache, done, k):
             logits, cache = forward(
                 params, tok[:, None], config, cache, logits_last_only=True,
                 pad_offsets=pad_offsets, attn_impl=attn_impl,
@@ -254,14 +262,43 @@ def make_decode_loop_fn(
             if stops is not None:
                 nxt = jnp.where(done, tok, nxt)
                 done = done | jnp.any(nxt[:, None] == stops[None, :], axis=-1)
-            return (nxt, cache, done), nxt
+            return nxt, cache, done
 
         done0 = (
             jnp.any(first_tok[:, None] == stops[None, :], axis=-1)
             if stops is not None
             else jnp.zeros(first_tok.shape, dtype=jnp.bool_)
         )
-        (_, cache, _), toks = lax.scan(body, (first_tok, cache, done0), keys)
+
+        if early_stop:
+            b = first_tok.shape[0]
+            keys = jax.random.split(key, num_steps)
+            buf0 = jnp.zeros((b, num_steps), jnp.int32)
+
+            def cond(state):
+                i, _, _, done, _ = state
+                return (i < num_steps) & ~jnp.all(done)
+
+            def body(state):
+                i, tok, cache, done, buf = state
+                nxt, cache, done = step(tok, cache, done, keys[i])
+                buf = lax.dynamic_update_slice(buf, nxt[:, None], (0, i))
+                return i + 1, nxt, cache, done, buf
+
+            _, _, cache, _, buf = lax.while_loop(
+                cond, body, (jnp.zeros((), jnp.int32), first_tok, cache,
+                             done0, buf0)
+            )
+            return buf, cache  # [B, steps]; tail zeros normalized by trim
+
+        keys = jax.random.split(key, num_steps)
+
+        def scan_body(carry, k):
+            tok, cache, done = carry
+            nxt, cache, done = step(tok, cache, done, k)
+            return (nxt, cache, done), nxt
+
+        (_, cache, _), toks = lax.scan(scan_body, (first_tok, cache, done0), keys)
         return jnp.moveaxis(toks, 0, 1), cache  # [B, steps]
 
     return decode_loop
@@ -289,6 +326,7 @@ class Generator:
         prefill_attn_impl: str = "xla",
         prefill_chunk: int | None = None,
         decode_attn_impl: str = "xla",
+        early_stop: bool = False,
     ) -> None:
         self.params = params
         self.config = config
@@ -322,7 +360,8 @@ class Generator:
         self.last_stream_stats: dict[str, Any] = {}
         self._step = make_decode_step_fn(config, self.sampler, decode_attn_impl)
         self._loop = make_decode_loop_fn(
-            config, self.sampler, self.stop_tokens, decode_attn_impl
+            config, self.sampler, self.stop_tokens, decode_attn_impl,
+            early_stop=early_stop,
         )
 
     def _init_cache(self, batch: int, max_seq_len: int) -> KVCache:

@@ -131,6 +131,15 @@ def test_cli_quantize_int4(fake_load, capsys):
     assert isinstance(text, str) and text
 
 
+def test_cli_early_stop_matches_plain(fake_load, capsys):
+    ref = cli.run(["--backend=tpu", "--sampler=greedy", "--max-tokens=8",
+                   "--dtype=f32", "--no-stream", "--prompt=hello"])
+    got = cli.run(["--backend=tpu", "--sampler=greedy", "--max-tokens=8",
+                   "--dtype=f32", "--no-stream", "--early-stop",
+                   "--prompt=hello"])
+    assert got == ref
+
+
 def test_cli_quantize_int8_a8_runs(fake_load, capsys):
     text = cli.run(["--backend=tpu", "--quantize=int8_a8", "--sampler=greedy",
                     "--max-tokens=5", "--dtype=f32", "--no-stream",

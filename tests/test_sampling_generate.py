@@ -130,6 +130,46 @@ def test_stop_tokens(tiny_model):
     np.testing.assert_array_equal(fused[: k + 1], plain[: k + 1])
 
 
+def test_early_stop_loop_matches_scan(tiny_model):
+    """The opt-in early-exit decode loop (lax.while_loop, exits at
+    all-done) must emit exactly what the fixed-trip scan emits — for
+    batches whose rows stop at different steps and for batches that never
+    stop."""
+    cfg, params, params_np = tiny_model
+    prompt = np.array([5, 1, 4, 1, 5], dtype=np.int32)
+    plain = greedy_generate_np(params_np, prompt, cfg, max_new_tokens=12)
+    stop = plain[4]  # some row stops mid-budget, maybe not at step 0
+    prompts = np.array([[5, 1, 4, 1, 5], [2, 7, 1, 8, 2]], dtype=np.int32)
+
+    scan_gen = Generator(params, cfg, sampler=Sampler(kind="greedy"),
+                         stop_tokens=(stop,), cache_dtype=jnp.float32)
+    early_gen = Generator(params, cfg, sampler=Sampler(kind="greedy"),
+                          stop_tokens=(stop,), cache_dtype=jnp.float32,
+                          early_stop=True)
+    a = scan_gen.generate(prompts, max_new_tokens=12).tokens
+    b = early_gen.generate(prompts, max_new_tokens=12).tokens
+    np.testing.assert_array_equal(a, b)
+
+    # a stop token nothing emits: both run the full budget, same output
+    never = Generator(params, cfg, sampler=Sampler(kind="greedy"),
+                      stop_tokens=(int(stop) + 1 % cfg.vocab_size,),
+                      cache_dtype=jnp.float32, early_stop=True)
+    ref = Generator(params, cfg, sampler=Sampler(kind="greedy"),
+                    stop_tokens=(int(stop) + 1 % cfg.vocab_size,),
+                    cache_dtype=jnp.float32)
+    np.testing.assert_array_equal(
+        never.generate(prompts, max_new_tokens=8).tokens,
+        ref.generate(prompts, max_new_tokens=8).tokens,
+    )
+
+
+def test_early_stop_requires_stop_tokens(tiny_model):
+    cfg, params, _ = tiny_model
+    with pytest.raises(ValueError, match="early_stop requires stop_tokens"):
+        Generator(params, cfg, sampler=Sampler(kind="greedy"),
+                  cache_dtype=jnp.float32, early_stop=True)
+
+
 def test_capacity_guard(tiny_model):
     cfg, params, _ = tiny_model
     gen = Generator(params, cfg, cache_dtype=jnp.float32)
