@@ -31,6 +31,10 @@ def build_parser(default_model: str) -> argparse.ArgumentParser:
                    help="HF repo id or local checkpoint dir")
     p.add_argument("--backend", choices=["tpu", "numpy"], default="tpu")
     p.add_argument("--prompt", default="Once upon a time")
+    p.add_argument("--batch-size", type=int, default=0, metavar="N",
+                   help="with --prompts-file: run the workload in ragged "
+                        "batches of N (longest-first grouping; 0 = one "
+                        "batch of everything)")
     p.add_argument("--prompts-file", default=None, metavar="PATH",
                    help="batch mode: one prompt per line, generated together "
                         "as a ragged batch (left-padded, per-row positions "
@@ -405,13 +409,30 @@ def _run_tpu(args) -> str:
     )
 
     if batch_prompt_ids is not None:
+        n_batches = 1
         with ctx:
-            res = gen.generate_ragged(
-                batch_prompt_ids, args.max_tokens,
-                max_seq_len=args.max_seq_len, seed=args.seed,
-            )
+            if args.batch_size and args.batch_size < len(batch_prompt_ids):
+                # dynamic batching: ragged batches of N, longest-first
+                results = gen.generate_many(
+                    batch_prompt_ids, args.max_tokens,
+                    batch_size=args.batch_size,
+                    max_seq_len=args.max_seq_len, seed=args.seed,
+                )
+                rows = [np.asarray(r.tokens)[0] for r in results]
+                ttft = results[0].ttft_s
+                rate = float(np.mean([r.decode_tokens_per_s for r in results]))
+                num_generated = results[0].num_generated
+                n_batches = -(-len(rows) // args.batch_size)
+            else:
+                res = gen.generate_ragged(
+                    batch_prompt_ids, args.max_tokens,
+                    max_seq_len=args.max_seq_len, seed=args.seed,
+                )
+                rows = list(np.asarray(res.tokens))
+                ttft, rate = res.ttft_s, res.decode_tokens_per_s
+                num_generated = res.num_generated
         texts, row_counts = [], []
-        for row in np.asarray(res.tokens):
+        for row in rows:
             if eos is not None and (row == eos).any():
                 row = row[: int(np.argmax(row == eos))]
             row_counts.append(len(row))
@@ -423,12 +444,13 @@ def _run_tpu(args) -> str:
             # rate; a row that hit EOS early still paid the full loop, so
             # its effective rate scales by its kept fraction
             per_row = [
-                f"{c}tok@{res.decode_tokens_per_s * c / res.num_generated:.1f}tok/s"
+                f"{c}tok@{rate * c / num_generated:.1f}tok/s"
                 for c in row_counts
             ]
             print(
-                f"[tpu] ragged batch of {len(texts)}: ttft {res.ttft_s:.3f}s, "
-                f"{res.decode_tokens_per_s:.1f} tok/s/row decode, rows: "
+                f"[tpu] ragged batch of {len(texts)}"
+                + (f" in {n_batches} batches" if n_batches > 1 else "")
+                + f": ttft {ttft:.3f}s, {rate:.1f} tok/s/row decode, rows: "
                 + " ".join(per_row),
                 file=sys.stderr,
             )

@@ -483,6 +483,47 @@ class Generator:
             pad_offsets=jnp.asarray(pads),
         )
 
+    def generate_many(
+        self,
+        prompts: list[np.ndarray | list[int]],
+        max_new_tokens: int,
+        *,
+        batch_size: int = 8,
+        max_seq_len: int | None = None,
+        seed: int = 0,
+    ) -> list[GenerateResult]:
+        """Dynamic batching over a workload of any size: prompts are
+        grouped (longest-first, so rows in a batch have similar lengths
+        and waste little pad) into ragged batches of ``batch_size`` and
+        each batch runs the fused path; results return in the caller's
+        original prompt order, one GenerateResult per batch with its rows.
+
+        With ``early_stop`` on the Generator, a batch whose rows all hit
+        EOS early releases the chip to the next batch — throughput-
+        oriented offline serving without a resident server.  (The
+        reference processes one prompt at a time, llama3.2_model.py:865.)
+        """
+        if batch_size < 1:
+            raise ValueError(f"batch_size must be >= 1, got {batch_size}")
+        order = sorted(
+            range(len(prompts)), key=lambda i: -len(np.asarray(prompts[i]).reshape(-1))
+        )
+        results: list[GenerateResult | None] = [None] * len(prompts)
+        for start in range(0, len(order), batch_size):
+            idx = order[start:start + batch_size]
+            res = self.generate_ragged(
+                [prompts[i] for i in idx], max_new_tokens,
+                max_seq_len=max_seq_len, seed=seed + start,
+            )
+            for row, i in enumerate(idx):
+                results[i] = GenerateResult(
+                    tokens=res.tokens[row:row + 1],
+                    ttft_s=res.ttft_s,
+                    decode_tokens_per_s=res.decode_tokens_per_s,
+                    num_generated=res.num_generated,
+                )
+        return results  # type: ignore[return-value]
+
     # -- streaming -----------------------------------------------------
     def stream(
         self,

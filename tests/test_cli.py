@@ -338,6 +338,21 @@ def test_cli_prompts_file_rejects_numpy(fake_load, tmp_path):
         cli.run(["--backend=numpy", f"--prompts-file={pf}"])
 
 
+def test_cli_prompts_file_batch_size(fake_load, capsys, tmp_path):
+    """--batch-size N chunks the workload into ragged batches; rows come
+    back in file order and match the single-batch run."""
+    prompts = ["hi", "hello there you", "hello", "yo yo", "a"]
+    pf = tmp_path / "p.txt"
+    pf.write_text("\n".join(prompts) + "\n")
+    want = cli.run(["--backend=tpu", "--sampler=greedy", "--max-tokens=5",
+                    "--dtype=f32", f"--prompts-file={pf}"])
+    got = cli.run(["--backend=tpu", "--sampler=greedy", "--max-tokens=5",
+                   "--dtype=f32", f"--prompts-file={pf}", "--batch-size=2",
+                   "--metrics"])
+    assert got == want
+    assert "in 3 batches" in capsys.readouterr().err
+
+
 def test_cli_prompts_file_composes_with_speculative(fake_load, capsys, tmp_path):
     """--prompts-file + --speculative: ragged speculation emits the same
     rows as plain ragged greedy generation (losslessness, batched)."""

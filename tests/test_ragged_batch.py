@@ -64,3 +64,44 @@ def test_ragged_equal_lengths_degenerates_to_plain(model):
     a = gen.generate_ragged(prompts, max_new_tokens=5).tokens
     b = gen.generate(np.stack(prompts), max_new_tokens=5).tokens
     np.testing.assert_array_equal(a, b)
+
+
+def test_generate_many_matches_one_batch(model):
+    """Dynamic batching (generate_many, longest-first groups of N) emits
+    per-prompt rows identical to the single-batch ragged run, in the
+    caller's original order."""
+    import numpy as np
+
+    from llm_np_cp_tpu.generate import Generator
+    from llm_np_cp_tpu.ops.sampling import Sampler
+
+    cfg, params = model
+    prompts = [
+        np.arange(n, dtype=np.int32) % cfg.vocab_size
+        for n in (3, 11, 5, 8, 2)
+    ]
+    gen = Generator(params, cfg, sampler=Sampler(kind="greedy"),
+                    cache_dtype=jnp.float32)
+    # per-row oracle: each prompt generated alone
+    want = [
+        np.asarray(gen.generate(p, 7).tokens)[0] for p in prompts
+    ]
+    results = gen.generate_many(prompts, 7, batch_size=2)
+    assert len(results) == len(prompts)
+    for i, r in enumerate(results):
+        np.testing.assert_array_equal(
+            np.asarray(r.tokens)[0], want[i], err_msg=f"prompt {i}"
+        )
+
+
+def test_generate_many_validates_batch_size(model):
+    import numpy as np
+
+    from llm_np_cp_tpu.generate import Generator
+    from llm_np_cp_tpu.ops.sampling import Sampler
+
+    cfg, params = model
+    gen = Generator(params, cfg, sampler=Sampler(kind="greedy"),
+                    cache_dtype=jnp.float32)
+    with pytest.raises(ValueError, match="batch_size"):
+        gen.generate_many([np.arange(3, dtype=np.int32)], 4, batch_size=0)
