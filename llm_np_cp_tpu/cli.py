@@ -107,6 +107,8 @@ def build_parser(default_model: str) -> argparse.ArgumentParser:
 def run(argv: list[str] | None = None, default_model: str = "meta-llama/Llama-3.2-1B") -> str:
     args = build_parser(default_model).parse_args(argv)
     _validate_draft(args)
+    if args.batch_size < 0:
+        raise SystemExit(f"--batch-size must be >= 0, got {args.batch_size}")
     if args.prompts_file and args.backend == "numpy":
         raise SystemExit(
             "--prompts-file batches through the tpu backend; the numpy "
@@ -419,8 +421,16 @@ def _run_tpu(args) -> str:
                     max_seq_len=args.max_seq_len, seed=args.seed,
                 )
                 rows = [np.asarray(r.tokens)[0] for r in results]
-                ttft = results[0].ttft_s
-                rate = float(np.mean([r.decode_tokens_per_s for r in results]))
+                # each result carries ITS batch's rate; time-to-first-
+                # output is the first EXECUTED batch's ttft — the one
+                # holding the longest prompt (longest-first grouping)
+                row_rates = [r.decode_tokens_per_s for r in results]
+                longest = max(
+                    range(len(batch_prompt_ids)),
+                    key=lambda i: len(batch_prompt_ids[i]),
+                )
+                ttft = results[longest].ttft_s
+                rate = float(np.mean(row_rates))
                 num_generated = results[0].num_generated
                 n_batches = -(-len(rows) // args.batch_size)
             else:
@@ -430,6 +440,7 @@ def _run_tpu(args) -> str:
                 )
                 rows = list(np.asarray(res.tokens))
                 ttft, rate = res.ttft_s, res.decode_tokens_per_s
+                row_rates = [rate] * len(rows)
                 num_generated = res.num_generated
         texts, row_counts = [], []
         for row in rows:
@@ -440,12 +451,11 @@ def _run_tpu(args) -> str:
         for text in texts:
             print(text)
         if args.metrics:
-            # decode_tokens_per_s is the fused loop's per-sequence step
-            # rate; a row that hit EOS early still paid the full loop, so
-            # its effective rate scales by its kept fraction
+            # each row scales ITS batch's per-sequence step rate by the
+            # kept fraction (a row that hit EOS early still paid the loop)
             per_row = [
-                f"{c}tok@{rate * c / num_generated:.1f}tok/s"
-                for c in row_counts
+                f"{c}tok@{r * c / num_generated:.1f}tok/s"
+                for c, r in zip(row_counts, row_rates)
             ]
             print(
                 f"[tpu] ragged batch of {len(texts)}"

@@ -495,8 +495,9 @@ class Generator:
         """Dynamic batching over a workload of any size: prompts are
         grouped (longest-first, so rows in a batch have similar lengths
         and waste little pad) into ragged batches of ``batch_size`` and
-        each batch runs the fused path; results return in the caller's
-        original prompt order, one GenerateResult per batch with its rows.
+        each batch runs the fused path; returns one GenerateResult PER
+        PROMPT (a single-row tokens array), in the caller's original
+        prompt order, each carrying its own batch's ttft/rate.
 
         With ``early_stop`` on the Generator, a batch whose rows all hit
         EOS early releases the chip to the next batch — throughput-

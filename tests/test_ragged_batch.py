@@ -70,11 +70,6 @@ def test_generate_many_matches_one_batch(model):
     """Dynamic batching (generate_many, longest-first groups of N) emits
     per-prompt rows identical to the single-batch ragged run, in the
     caller's original order."""
-    import numpy as np
-
-    from llm_np_cp_tpu.generate import Generator
-    from llm_np_cp_tpu.ops.sampling import Sampler
-
     cfg, params = model
     prompts = [
         np.arange(n, dtype=np.int32) % cfg.vocab_size
@@ -95,11 +90,6 @@ def test_generate_many_matches_one_batch(model):
 
 
 def test_generate_many_validates_batch_size(model):
-    import numpy as np
-
-    from llm_np_cp_tpu.generate import Generator
-    from llm_np_cp_tpu.ops.sampling import Sampler
-
     cfg, params = model
     gen = Generator(params, cfg, sampler=Sampler(kind="greedy"),
                     cache_dtype=jnp.float32)
