@@ -71,6 +71,34 @@ def test_flash_attention_8k_lowers_for_tpu(window, softcap):
     )
 
 
+@pytest.mark.parametrize("cache_dtype", ["bf16", "int8"])
+def test_full_fdec_decode_loop_lowers_for_tpu(cache_dtype):
+    """The ENTIRE fused decode loop with the Pallas kernel inside the
+    layer scan (the program the fdec bench configs dispatch), at the real
+    llama-1B headline shape — integration-level Mosaic serialization, not
+    just the kernel alone."""
+    from llm_np_cp_tpu.cache import KVCache, align_capacity
+    from llm_np_cp_tpu.config import LLAMA_3_2_1B
+    from llm_np_cp_tpu.generate import make_decode_loop_fn
+    from llm_np_cp_tpu.models.transformer import init_params
+    from llm_np_cp_tpu.ops.sampling import Sampler
+
+    cfg = LLAMA_3_2_1B
+    params = jax.eval_shape(
+        lambda: init_params(jax.random.PRNGKey(0), cfg, dtype=jnp.bfloat16)
+    )
+    cap = align_capacity(128 + 256 + 8)
+    cdt = jnp.int8 if cache_dtype == "int8" else jnp.bfloat16
+    cache = jax.eval_shape(lambda: KVCache.init(cfg, 8, cap, dtype=cdt))
+    loop = make_decode_loop_fn(
+        cfg, Sampler(kind="greedy"), attn_impl="flash_decode"
+    )
+    tok = jax.ShapeDtypeStruct((8,), jnp.int32)
+    key = jax.ShapeDtypeStruct((2,), jnp.uint32)
+    _export_tpu(jax.jit(lambda p, t, c, k: loop(p, t, c, k, 8)),
+                params, tok, cache, key)
+
+
 def test_gemma2_decode_shape_lowers_for_tpu():
     # Gemma-2-2B: 8 q heads over 4 KV heads of 256 dim — the wide-head
     # layout class (trailing dims (4, 256))
