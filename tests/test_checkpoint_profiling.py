@@ -92,3 +92,31 @@ def test_stopwatch():
     sw.mark("a")
     sw.mark("b", jnp.arange(8) * 2)
     assert sw.span("a", "b") >= 0
+
+
+def test_quantized_params_checkpoint_roundtrip(tmp_path):
+    """Quantized pytrees ({q|qa|q4, s} dict leaves) save/restore through
+    orbax unchanged — quantize once, serve from the checkpoint."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from llm_np_cp_tpu.config import tiny_config
+    from llm_np_cp_tpu.models.transformer import init_params
+    from llm_np_cp_tpu.quant import quantize_params
+    from llm_np_cp_tpu.utils.checkpoint import restore_checkpoint, save_checkpoint
+
+    cfg = tiny_config("llama")
+    params = init_params(jax.random.PRNGKey(3), cfg, dtype=jnp.float32)
+    for kwargs in (dict(bits=8), dict(bits=4), dict(bits=8, act_quant=True)):
+        q = quantize_params(params, **kwargs)
+        path = tmp_path / f"ck_{kwargs.get('bits')}_{kwargs.get('act_quant', False)}"
+        save_checkpoint(path, {"params": q, "step": 7})
+        back = restore_checkpoint(path)
+        assert int(back["step"]) == 7
+        flat_a = jax.tree.leaves(q)
+        flat_b = jax.tree.leaves(back["params"])
+        assert len(flat_a) == len(flat_b)
+        for a, b in zip(flat_a, flat_b):
+            assert a.dtype == b.dtype
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
