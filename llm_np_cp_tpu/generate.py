@@ -446,6 +446,13 @@ class Generator:
         counts).  Used by Generator.generate_ragged and
         SpeculativeGenerator.generate_ragged."""
         arrs = [np.asarray(p, dtype=np.int32).reshape(-1) for p in prompts]
+        if not arrs:
+            raise ValueError("left_pad needs at least one prompt")
+        empty = [i for i, a in enumerate(arrs) if a.size == 0]
+        if empty:
+            # an all-pad row would sample its first token from a fully
+            # masked attention — fail fast instead of emitting garbage
+            raise ValueError(f"empty prompt at index {empty[0]}")
         s = max(a.size for a in arrs)
         b = len(arrs)
         ids = np.zeros((b, s), dtype=np.int32)

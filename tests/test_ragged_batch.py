@@ -95,3 +95,10 @@ def test_generate_many_validates_batch_size(model):
                     cache_dtype=jnp.float32)
     with pytest.raises(ValueError, match="batch_size"):
         gen.generate_many([np.arange(3, dtype=np.int32)], 4, batch_size=0)
+
+
+def test_left_pad_rejects_empty_prompts():
+    with pytest.raises(ValueError, match="empty prompt at index 1"):
+        Generator.left_pad([np.array([1, 2]), np.array([], dtype=np.int32)])
+    with pytest.raises(ValueError, match="at least one"):
+        Generator.left_pad([])
