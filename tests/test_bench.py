@@ -98,6 +98,18 @@ def test_warm_smoke_offline():
                                  and n not in bench.EXTRA_CHILDREN}
 
 
+def test_warm_limit_covers_top_priority_only():
+    """BENCH_WARM_LIMIT=N (tight-deadline mode) warms exactly the first N
+    warmable priority configs and skips the ragged block."""
+    res = bench._spawn("warm", 600, env={"BENCH_WARM_LIMIT": "3"})
+    assert res.get("ok") is True, res
+    warmable = [n for n in bench.PRIORITY
+                if n not in bench.SPEC_CONFIGS
+                and n not in bench.EXTRA_CHILDREN
+                and n not in bench.RAGGED_CONFIGS]
+    assert res["warmed"] == warmable[:3]
+
+
 def test_ragged_smoke_offline():
     """The ragged decode child (mixed prompt lengths, marginal pair
     measurement) runs end-to-end on CPU with the tiny model."""
