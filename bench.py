@@ -144,6 +144,19 @@ RAGGED_CONFIGS = {
 RAGGED_LENS = (4096, 2048, 1536, 1024, 768, 512, 256, 128)
 RAGGED_DECODE = 64
 
+# Continuous-batching serving engine (llm_np_cp_tpu/serve/): replay a
+# Poisson arrival trace through ServeEngine's paged-pool decode and
+# report TTFT/throughput percentiles — the request-level number the
+# ROADMAP north star ("heavy traffic") is actually about, vs the
+# batch-job numbers above.
+SERVE_CONFIGS = {
+    "serve_poisson_bs8": dict(model="llama1b", requests=32, rate=16.0,
+                              prompt_len=512, max_tokens=64, slots=8,
+                              block_size=128),
+    "smoke_serve": dict(model="tiny", requests=8, rate=100.0, prompt_len=16,
+                        max_tokens=6, slots=2, block_size=8),
+}
+
 SPEC_CONFIGS = {
     # batched self-speculation: bf16 target + int8 self-draft, γ=4
     "int8_spec_bs8": dict(model="llama1b", batch=8, prompt_len=128,
@@ -177,6 +190,7 @@ PRIORITY = [
     "llama1b_bs8_fdec",   # rewritten decode kernel at the headline shape
     "ragged_bs8_xla",     # ragged decode: the kernel's structural win case
     "ragged_bs8_fdec",
+    "serve_poisson_bs8",  # continuous-batching serving engine (serve/)
     "gemma2_2b_bs8",      # Gemma north-star number (VERDICT task 3)
     "int8_bs8",           # roofline-gap anchor (VERDICT task 6)
     "int8a8_bs8",         # W8A8 int8-MXU einsums vs that anchor
@@ -205,7 +219,7 @@ EXTRA_CHILDREN = {"decomp"}
 assert set(PRIORITY) == {
     n
     for n in list(DECODE_CONFIGS) + list(SPEC_CONFIGS)
-    + list(PREFILL_CONFIGS) + list(RAGGED_CONFIGS)
+    + list(PREFILL_CONFIGS) + list(RAGGED_CONFIGS) + list(SERVE_CONFIGS)
     if not n.startswith("smoke")
 } | EXTRA_CHILDREN, "PRIORITY out of sync with config dicts"
 
@@ -216,6 +230,9 @@ TIMEOUTS = {
     "decomp": 850,  # 6 decode-loop compiles (full/half × 3 quant modes) + head
     "ragged_bs8_xla": 600,  # 2 prefill + 2 loop compiles + 3 rep pairs
     "ragged_bs8_fdec": 600,
+    # ~290 host-driven device dispatches (32 prefills + ~256 decode
+    # ticks) + 4 program compiles; per-tick host latency dominates
+    "serve_poisson_bs8": 600,
     # prefill-dominated: the marginal measurement's extra prefill+half
     # decode per rep nearly doubles measured-phase wall time
     "llama3b_seq2048_bs8": 700,
@@ -366,7 +383,7 @@ def _measure_decode(name, config, params, prefill, loop, batch, prompt_len,
         np.asarray(tok0)  # force real D2H — block_until_ready is not a fence here
         t1 = time.perf_counter()
         _phase(name, f"{tag}:prefill_done", t_start, dt=round(t1 - t0, 1))
-        toks, cache = loop(params, tok0, cache, key, decode_tokens)
+        toks, cache, _steps = loop(params, tok0, cache, key, decode_tokens)
         toks_host = np.asarray(toks)
         t2 = time.perf_counter()
         _phase(name, f"{tag}:decode_done", t_start, dt=round(t2 - t1, 1))
@@ -383,7 +400,7 @@ def _measure_decode(name, config, params, prefill, loop, batch, prompt_len,
         )
         np.asarray(tok_h)  # fence: keep prefill out of the half timing
         t3 = time.perf_counter()
-        toks_h, _ = loop(params, tok_h, cache_h, key, half)
+        toks_h, _, _ = loop(params, tok_h, cache_h, key, half)
         np.asarray(toks_h)
         t4 = time.perf_counter()
         _phase(name, f"{tag}:half_done", t_start, dt=round(t4 - t3, 1))
@@ -628,6 +645,86 @@ def run_ragged_config(name: str) -> dict:
     }
 
 
+def run_serve_config(name: str) -> dict:
+    """Continuous-batching serving scenario: replay a Poisson arrival
+    trace through ServeEngine and report the REQUEST-level numbers
+    (TTFT percentiles, per-request decode tok/s, preemptions, pool
+    occupancy) that the batch-shaped configs above cannot measure.
+    Wall-clock here includes scheduler/host time — that is the point:
+    serving throughput is what a user-facing deployment gets."""
+    import numpy as np
+
+    from llm_np_cp_tpu.ops.sampling import Sampler
+    from llm_np_cp_tpu.serve import ServeEngine, poisson_trace
+
+    t0 = time.perf_counter()
+    spec = SERVE_CONFIGS[name]
+    config, params = _build_model(spec["model"], tag=name, t0=t0)
+    _phase(name, "params_built", t0)
+    from llm_np_cp_tpu.serve.engine import pool_geometry
+
+    bs = spec["block_size"]
+    chunk = min(bs * 2, 256)
+    _, sized_blocks, max_seq_len = pool_geometry(
+        spec["prompt_len"], spec["max_tokens"], spec["slots"], bs,
+        prefill_chunk=chunk,
+    )
+    num_blocks = spec.get("num_blocks", sized_blocks)
+    engine = ServeEngine(
+        params, config,
+        sampler=Sampler(kind="greedy"),
+        max_slots=spec["slots"],
+        num_blocks=num_blocks,
+        block_size=bs,
+        max_seq_len=max_seq_len,
+        prefill_chunk=chunk,
+    )
+    # seed 13 for both the trace rng and per-request sampler seeds:
+    # `serve-bench --seed 13` with matching flags replays the SAME trace
+    rng = np.random.default_rng(13)
+    trace = poisson_trace(
+        rng, spec["requests"], rate_rps=spec["rate"],
+        prompt_len_range=(max(spec["prompt_len"] // 4, 1),
+                          spec["prompt_len"]),
+        max_new_tokens=spec["max_tokens"], vocab_size=config.vocab_size,
+        seed_base=13,
+    )
+    _phase(name, "trace_built", t0)
+    # compile outside the measured span: the replay must report
+    # steady-state serving numbers, not first-compile stalls
+    engine.warmup([int(t["prompt"].size) for t in trace],
+                  max_new_tokens=spec["max_tokens"])
+    _phase(name, "warmed", t0)
+    snap = engine.replay_trace(trace)
+    _phase(name, "trace_drained", t0, ticks=snap["ticks"])
+    # record whether the block-table-native kernel compiles on this
+    # backend (the ROADMAP follow-up integrates it into the decode
+    # forward; the live-TPU round reads this verdict first)
+    from llm_np_cp_tpu.ops.pallas.support import kernel_error
+
+    paged_err = kernel_error("paged_decode_attention")
+    return {
+        "config": name,
+        "ok": snap["finished"] == spec["requests"],
+        "requests": spec["requests"],
+        "rate_rps": spec["rate"],
+        "slots": spec["slots"],
+        "pool_blocks": num_blocks,
+        "block_size": bs,
+        "throughput_tok_s": round(snap["throughput_tok_s"], 1),
+        "ttft_s_p50": round(snap.get("ttft_s_p50", float("nan")), 4),
+        "ttft_s_p99": round(snap.get("ttft_s_p99", float("nan")), 4),
+        "decode_tok_s_p50": round(snap.get("decode_tok_s_p50",
+                                           float("nan")), 1),
+        "preemptions": snap["preemptions"],
+        "occupancy_p99": round(snap.get("occupancy_p99", 0.0), 3),
+        "active_slots_mean": round(snap.get("active_slots_mean", 0.0), 2),
+        "ticks": snap["ticks"],
+        "compile_counts": engine.compile_counts(),
+        "paged_kernel_probe": paged_err or "ok",
+    }
+
+
 def run_spec_config(name: str) -> dict:
     import numpy as np
 
@@ -725,7 +822,7 @@ def run_warm() -> dict:
     warmable = [
         n for n in PRIORITY
         if n not in SPEC_CONFIGS and n not in EXTRA_CHILDREN
-        and n not in RAGGED_CONFIGS
+        and n not in RAGGED_CONFIGS and n not in SERVE_CONFIGS
     ]
     for name in warmable[:warm_limit]:
         spec = {**DECODE_CONFIGS, **PREFILL_CONFIGS}[name]
@@ -1062,6 +1159,8 @@ def child_main(mode: str) -> None:
         out = run_spec_config(mode)
     elif mode in RAGGED_CONFIGS:
         out = run_ragged_config(mode)
+    elif mode in SERVE_CONFIGS:
+        out = run_serve_config(mode)
     else:
         raise SystemExit(f"unknown config {mode!r}")
     print(json.dumps(out), flush=True)
@@ -1321,7 +1420,7 @@ def main() -> None:
         budget = min(TIMEOUTS.get(name, DEFAULT_TIMEOUT), remaining - 10)
         spec_env = {
             **DECODE_CONFIGS, **PREFILL_CONFIGS, **SPEC_CONFIGS,
-            **RAGGED_CONFIGS,
+            **RAGGED_CONFIGS, **SERVE_CONFIGS,
         }.get(name, {}).get("env")
         res = _spawn(name, budget, env=spec_env)
         detail[name] = res

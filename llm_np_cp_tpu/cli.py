@@ -25,7 +25,10 @@ import numpy as np
 
 def build_parser(default_model: str) -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
-        description="TPU-native LLM inference (llm_np_cp capability surface)"
+        description="TPU-native LLM inference (llm_np_cp capability surface)",
+        epilog="subcommand: serve-bench — replay a Poisson trace through "
+        "the continuous-batching ServeEngine (see serve-bench --help); "
+        "dispatched before this parser, so it accepts only its own flags",
     )
     p.add_argument("--model", default=default_model,
                    help="HF repo id or local checkpoint dir")
@@ -104,7 +107,113 @@ def build_parser(default_model: str) -> argparse.ArgumentParser:
     return p
 
 
+def build_serve_parser(default_model: str) -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="serve-bench",
+        description="Replay a synthetic Poisson arrival trace through the "
+        "continuous-batching ServeEngine and report TTFT/throughput "
+        "percentiles (llm_np_cp_tpu/serve/)",
+    )
+    p.add_argument("--model", default=default_model)
+    p.add_argument("--requests", type=int, default=16,
+                   help="number of synthetic requests in the trace")
+    p.add_argument("--rate", type=float, default=8.0, metavar="RPS",
+                   help="mean Poisson arrival rate, requests/second")
+    p.add_argument("--prompt-len", type=int, default=64, metavar="MAX",
+                   help="prompt lengths are uniform in [MAX//4, MAX]")
+    p.add_argument("--max-tokens", type=int, default=32,
+                   help="decode budget per request")
+    p.add_argument("--slots", type=int, default=4,
+                   help="decode slots (packed batch width)")
+    p.add_argument("--block-size", type=int, default=64,
+                   help="KV pool block size in cache slots (multiple of 8)")
+    p.add_argument("--num-blocks", type=int, default=0,
+                   help="KV pool blocks; 0 sizes the pool so every slot "
+                   "can hold a worst-case request plus one spare block")
+    p.add_argument("--cache-dtype", choices=["bf16", "f32", "int8"],
+                   default="bf16")
+    p.add_argument("--decode-attn", choices=["xla", "pallas"], default="xla",
+                   help="decode attention for the packed step (pallas is "
+                   "gated: it silently downgrades off-TPU)")
+    p.add_argument("--sampler", choices=["greedy", "min_p", "top_k", "top_p",
+                                         "cdf"], default="greedy")
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--dtype", choices=["bf16", "f32"], default="bf16")
+    p.add_argument("--realtime", action="store_true",
+                   help="sleep until each arrival instead of the virtual "
+                   "clock (live serving simulation)")
+    p.add_argument("--json", action="store_true",
+                   help="also print the full metrics snapshot as one JSON "
+                   "line")
+    return p
+
+
+def _run_serve_bench(argv: list[str], default_model: str) -> str:
+    import json as _json
+
+    import jax.numpy as jnp
+
+    from llm_np_cp_tpu.ops.sampling import Sampler
+    from llm_np_cp_tpu.serve import ServeEngine, poisson_trace
+
+    args = build_serve_parser(default_model).parse_args(argv)
+    if args.block_size < 8 or args.block_size % 8:
+        raise SystemExit(
+            f"--block-size must be a multiple of 8, got {args.block_size}"
+        )
+    _tok, params, config = _load(args)
+    cache_dtype = {
+        "bf16": jnp.bfloat16, "f32": jnp.float32, "int8": jnp.int8,
+    }[args.cache_dtype]
+    from llm_np_cp_tpu.serve.engine import pool_geometry
+
+    # same chunking as bench.run_serve_config, so the README's CLI line
+    # compiles the same prefill programs as the recorded bench numbers
+    chunk = min(args.block_size * 2, 256)
+    _, sized_blocks, max_seq_len = pool_geometry(
+        args.prompt_len, args.max_tokens, args.slots, args.block_size,
+        prefill_chunk=chunk,
+    )
+    num_blocks = args.num_blocks or sized_blocks
+    engine = ServeEngine(
+        params, config,
+        sampler=Sampler(kind=args.sampler),
+        max_slots=args.slots,
+        num_blocks=num_blocks,
+        block_size=args.block_size,
+        max_seq_len=max_seq_len,
+        prefill_chunk=chunk,
+        cache_dtype=cache_dtype,
+        decode_attn_impl="flash_decode" if args.decode_attn == "pallas"
+        else "xla",
+    )
+    rng = np.random.default_rng(args.seed)
+    trace = poisson_trace(
+        rng, args.requests, rate_rps=args.rate,
+        prompt_len_range=(max(args.prompt_len // 4, 1), args.prompt_len),
+        max_new_tokens=args.max_tokens, vocab_size=config.vocab_size,
+        seed_base=args.seed,
+    )
+    # compile outside the measured span (steady-state numbers only)
+    engine.warmup([int(t["prompt"].size) for t in trace],
+                  max_new_tokens=args.max_tokens)
+    snap = engine.replay_trace(trace, realtime=args.realtime)
+    out = (
+        f"[serve-bench] {args.requests} requests @ {args.rate} req/s, "
+        f"slots={args.slots}, pool={num_blocks}x{args.block_size} "
+        f"({args.cache_dtype})\n" + engine.metrics.format()
+    )
+    print(out)
+    if args.json:
+        print(_json.dumps(snap))
+    return out
+
+
 def run(argv: list[str] | None = None, default_model: str = "meta-llama/Llama-3.2-1B") -> str:
+    if argv is None:
+        argv = sys.argv[1:]
+    if argv and argv[0] == "serve-bench":
+        return _run_serve_bench(argv[1:], default_model)
     args = build_parser(default_model).parse_args(argv)
     _validate_draft(args)
     if args.batch_size < 0:
@@ -309,6 +418,14 @@ def _run_tpu(args) -> str:
             "--speculative uses its own fused draft/verify pipeline; "
             "--attn-impl/--flash-prefill/--decode-attn do not apply to it"
         )
+    if args.speculative > 0 and (args.batch_size or args.early_stop):
+        # these flags were silently ignored on the speculative branch
+        # (ADVICE r5); reject loudly like the kernel flags above
+        raise SystemExit(
+            "--speculative does not implement --batch-size grouping or "
+            "--early-stop (its verify loop has its own stopping rule); "
+            "drop those flags or drop --speculative"
+        )
     attn_impl = args.attn_impl or ("flash" if args.flash_prefill else "xla")
     if attn_impl == "ring" and (mesh is None or seq <= 1):
         raise SystemExit(
@@ -431,7 +548,7 @@ def _run_tpu(args) -> str:
                 )
                 ttft = results[longest].ttft_s
                 rate = float(np.mean(row_rates))
-                num_generated = results[0].num_generated
+                row_steps = [r.steps for r in results]
                 n_batches = -(-len(rows) // args.batch_size)
             else:
                 res = gen.generate_ragged(
@@ -441,7 +558,7 @@ def _run_tpu(args) -> str:
                 rows = list(np.asarray(res.tokens))
                 ttft, rate = res.ttft_s, res.decode_tokens_per_s
                 row_rates = [rate] * len(rows)
-                num_generated = res.num_generated
+                row_steps = [res.steps] * len(rows)
         texts, row_counts = [], []
         for row in rows:
             if eos is not None and (row == eos).any():
@@ -452,10 +569,14 @@ def _run_tpu(args) -> str:
             print(text)
         if args.metrics:
             # each row scales ITS batch's per-sequence step rate by the
-            # kept fraction (a row that hit EOS early still paid the loop)
+            # kept fraction (a row that hit EOS early still paid the
+            # loop).  The denominator is steps EXECUTED + the prefill
+            # token — with early_stop the loop may exit before the
+            # budget, and the old budget-based denominator overstated
+            # per-row rates (ADVICE r5).
             per_row = [
-                f"{c}tok@{r * c / num_generated:.1f}tok/s"
-                for c, r in zip(row_counts, row_rates)
+                f"{c}tok@{r * c / max(s + 1, 1):.1f}tok/s"
+                for c, r, s in zip(row_counts, row_rates, row_steps)
             ]
             print(
                 f"[tpu] ragged batch of {len(texts)}"
@@ -495,3 +616,7 @@ def _run_tpu(args) -> str:
                 file=sys.stderr,
             )
         return text
+
+
+if __name__ == "__main__":
+    run()

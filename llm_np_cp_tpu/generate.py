@@ -46,6 +46,11 @@ class GenerateResult:
     decode_tokens_per_s: float  # steady-state decode rate (per sequence)
     num_generated: int
     text: list[str] | None = None
+    # decode-loop steps actually EXECUTED (== num_generated-1 for the
+    # fixed-trip scan; < that when early_stop exits before the budget).
+    # The rate above divides by this, not the budget — an early-stopped
+    # batch must not overstate its tok/s (ADVICE r5).
+    steps: int = 0
 
 
 def _check_capacity(prompt_len: int, max_new_tokens: int, max_seq_len: int) -> None:
@@ -99,6 +104,33 @@ def make_prefill_fn(
     return prefill
 
 
+def make_ragged_prefill_step(config: ModelConfig) -> Callable:
+    """(params, ids, cache, mask, pads) → (last_logits [B, V], cache) —
+    one ragged (left-padded) prefill chunk at the cache's running offset.
+
+    The cache's validity bitmap persists pad slots masked in earlier
+    chunks (models/transformer.py), and positions derive from the running
+    cache offset minus pad_offsets — so a chunk-sliced attn_mask composes
+    exactly with chunking.  The cache is DONATED; callers rebind it.
+
+    Module-level factory so the serving engine (serve/engine.py) compiles
+    the SAME program shape the chunked prefill path dispatches.
+    """
+
+    @partial(jax.jit, donate_argnums=(2,))
+    def ragged_step(
+        params: Params, ids: jnp.ndarray, cache: KVCache,
+        mask: jnp.ndarray, pads: jnp.ndarray,
+    ):
+        logits, cache = forward(
+            params, ids, config, cache, logits_last_only=True,
+            attn_mask=mask, pad_offsets=pads, attn_impl="xla",
+        )
+        return logits[:, -1], cache
+
+    return ragged_step
+
+
 def make_chunked_prefill_fn(
     config: ModelConfig,
     sampler: Sampler,
@@ -139,21 +171,9 @@ def make_chunked_prefill_fn(
     chunk_step = _make_step("xla")
     first_step = chunk_step if attn_impl == "xla" else _make_step(attn_impl)
 
-    # Ragged (left-padded) chunks: the cache's validity bitmap persists
-    # pad slots masked in earlier chunks (models/transformer.py), and
-    # positions derive from the running cache offset minus pad_offsets —
-    # so a chunk-sliced attn_mask composes exactly with chunking.  A
-    # separate jitted step so the dense program keeps its shape.
-    @partial(jax.jit, donate_argnums=(2,))
-    def ragged_step(
-        params: Params, ids: jnp.ndarray, cache: KVCache,
-        mask: jnp.ndarray, pads: jnp.ndarray,
-    ):
-        logits, cache = forward(
-            params, ids, config, cache, logits_last_only=True,
-            attn_mask=mask, pad_offsets=pads, attn_impl="xla",
-        )
-        return logits[:, -1], cache
+    # Ragged (left-padded) chunks: a separate jitted step so the dense
+    # program keeps its shape (see make_ragged_prefill_step).
+    ragged_step = make_ragged_prefill_step(config)
 
     def prefill_chunked(
         params: Params,
@@ -223,7 +243,8 @@ def make_decode_loop_fn(
     attn_impl: str = "xla",
     early_stop: bool = False,
 ) -> Callable:
-    """(params, first_tok, cache, key, num_steps) → (tokens [B, steps], cache).
+    """(params, first_tok, cache, key, num_steps) →
+    (tokens [B, num_steps], cache, steps_executed int32).
 
     The fused loop: ``lax.scan`` over decode steps entirely on device.
     ``num_steps`` is static (one compile per distinct value).  Sequences
@@ -285,11 +306,13 @@ def make_decode_loop_fn(
                 buf = lax.dynamic_update_slice(buf, nxt[:, None], (0, i))
                 return i + 1, nxt, cache, done, buf
 
-            _, _, cache, _, buf = lax.while_loop(
+            i, _, cache, _, buf = lax.while_loop(
                 cond, body, (jnp.zeros((), jnp.int32), first_tok, cache,
                              done0, buf0)
             )
-            return buf, cache  # [B, steps]; tail zeros normalized by trim
+            # i = steps actually EXECUTED (< num_steps when every row hit
+            # EOS early); callers compute tok/s from it, not the budget
+            return buf, cache, i  # [B, steps]; tail zeros normalized by trim
 
         keys = jax.random.split(key, num_steps)
 
@@ -299,7 +322,8 @@ def make_decode_loop_fn(
             return (nxt, cache, done), nxt
 
         (_, cache, _), toks = lax.scan(scan_body, (first_tok, cache, done0), keys)
-        return jnp.moveaxis(toks, 0, 1), cache  # [B, steps]
+        steps = jnp.asarray(num_steps, jnp.int32)  # fixed-trip: all executed
+        return jnp.moveaxis(toks, 0, 1), cache, steps  # [B, steps]
 
     return decode_loop
 
@@ -307,6 +331,36 @@ def make_decode_loop_fn(
 # ----------------------------------------------------------------------
 # High-level API
 # ----------------------------------------------------------------------
+
+class IncrementalDetok:
+    """Incremental detokenization: decode the full id list on every push
+    and emit only the delta, holding back while the tail may still change
+    (mid-UTF-8 merge — avoids the reference's per-step token→text→token
+    roundtrip, llama3.2_model.py:873-883).  The ONE held-back rule shared
+    by Generator.stream_text and the serving engine's per-request
+    streams."""
+
+    def __init__(self, tokenizer: Any) -> None:
+        self.tokenizer = tokenizer
+        self.ids: list[int] = []
+        self.emitted = ""
+
+    def push(self, token_id: int) -> str | None:
+        """Append one id; return the newly-stable text delta, if any."""
+        self.ids.append(int(token_id))
+        text = self.tokenizer.decode(self.ids, skip_special_tokens=True)
+        if text.endswith("�"):
+            return None
+        delta, self.emitted = text[len(self.emitted):], text
+        return delta or None
+
+    def flush(self) -> str | None:
+        """Emit any held-back tail (call once, after the last push)."""
+        text = self.tokenizer.decode(self.ids, skip_special_tokens=True)
+        delta = text[len(self.emitted):]
+        self.emitted = text
+        return delta or None
+
 
 class Generator:
     """Owns jitted prefill/decode programs for one (model, sampler) pair.
@@ -402,16 +456,21 @@ class Generator:
         t1 = time.perf_counter()
 
         if max_new_tokens > 1:
-            rest, cache = self._loop(
+            rest, cache, steps_dev = self._loop(
                 self.params, tok0, cache, k_loop, max_new_tokens - 1, pad_offsets
             )
             rest.block_until_ready()
             t2 = time.perf_counter()
             tokens = np.concatenate([np.asarray(tok0)[:, None], np.asarray(rest)], axis=1)
-            rate = (max_new_tokens - 1) / (t2 - t1)
+            # rate over steps actually EXECUTED: under early_stop the
+            # while_loop may exit before the budget, and dividing the
+            # budget by the (shorter) loop time overstated tok/s
+            steps = int(np.asarray(steps_dev))
+            rate = steps / (t2 - t1) if steps > 0 else float("nan")
         else:
             tokens = np.asarray(tok0)[:, None]
             rate = float("nan")
+            steps = 0
 
         tokens = _trim_after_stop(tokens, self.stop_tokens)
         return GenerateResult(
@@ -419,6 +478,7 @@ class Generator:
             ttft_s=t1 - t0,
             decode_tokens_per_s=rate,
             num_generated=tokens.shape[1],
+            steps=steps,
         )
 
     # -- fused ---------------------------------------------------------
@@ -529,6 +589,7 @@ class Generator:
                     ttft_s=res.ttft_s,
                     decode_tokens_per_s=res.decode_tokens_per_s,
                     num_generated=res.num_generated,
+                    steps=res.steps,
                 )
         return results  # type: ignore[return-value]
 
@@ -581,33 +642,24 @@ class Generator:
         (llama3.2_model.py:873-883) while handling multi-byte merges.
         """
         prompt_ids = tokenizer(prompt, return_tensors="np")["input_ids"][0]
-        ids: list[int] = []
-        emitted = ""
+        detok = IncrementalDetok(tokenizer)
         t0 = time.perf_counter()
         ttft = None
         for t in self.stream(prompt_ids, max_new_tokens, seed=seed):
             if ttft is None:
                 ttft = time.perf_counter() - t0
-            ids.append(t)
-            text = tokenizer.decode(ids, skip_special_tokens=True)
-            # hold back while the last char may still change (e.g. mid UTF-8)
-            if text.endswith("�"):
-                continue
-            delta, emitted = text[len(emitted):], text
+            delta = detok.push(t)
             if echo and delta:
                 echo(delta)
-        # final flush of any held-back tail
-        text = tokenizer.decode(ids, skip_special_tokens=True)
-        if text != emitted:
-            if echo:
-                echo(text[len(emitted):])
-            emitted = text
+        tail = detok.flush()
+        if echo and tail:
+            echo(tail)
         self.last_stream_stats = {
-            "tokens": len(ids),
+            "tokens": len(detok.ids),
             "ttft_s": ttft,
             "duration_s": time.perf_counter() - t0,
         }
-        return emitted
+        return detok.emitted
 
 
 def _trim_after_stop(tokens: np.ndarray, stop_tokens: tuple[int, ...]) -> np.ndarray:

@@ -196,6 +196,174 @@ def _block_bounds(mask: jnp.ndarray, block_s: int, n_blocks: int) -> jnp.ndarray
     return jnp.stack([start, nb]).astype(jnp.int32)  # [2, B]
 
 
+def _paged_kernel(
+    meta_ref, tables_ref, q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref,
+    *, scale: float, softcap: float | None, kv_heads: int, group: int,
+    block_s: int,
+):
+    """Block-table variant of ``_decode_kernel``: the kv grid step fetches
+    the POOL block named by the row's table (scalar-prefetched), so the
+    serving engine's gather→contiguous copy never materializes.  The
+    visibility mask is derived in-kernel from the row's (pad, length)
+    scalars instead of a streamed [B, S] mask operand."""
+    bi = pl.program_id(0)
+    j = pl.program_id(1)
+    nj = pl.num_programs(1)
+    start, nb = meta_ref[0, bi], meta_ref[1, bi]
+    pad, length = meta_ref[2, bi], meta_ref[3, bi]
+
+    @pl.when(j == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    @pl.when(start + j < nb)
+    def _update():
+        # rank-2 iota over the minor dim — Mosaic rejects rank-1 iota on
+        # TPU (the r3-postmortem failure class; interpret mode hides it)
+        pos = (start + j) * block_s + jax.lax.broadcasted_iota(
+            jnp.int32, (1, block_s), 1
+        )
+        mask = (pos >= pad) & (pos < length)  # [1, block_s]
+        kb = k_ref[0]  # [block_s, K, D]
+        vb = v_ref[0]
+        s = jnp.concatenate(
+            [
+                jax.lax.dot_general(
+                    q_ref[0, ki], kb[:, ki], (((1,), (1,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                )
+                for ki in range(kv_heads)
+            ],
+            axis=0,
+        ) * scale  # [H, block_s]
+        if softcap is not None:
+            s = jnp.tanh(s / softcap) * softcap
+        s = jnp.where(mask, s, NEG_INF)
+
+        m_prev = m_ref[:]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        p = jnp.where(mask, p, 0.0)
+        alpha = jnp.exp(m_prev - m_new)
+        l_ref[:] = l_ref[:] * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        pb = p.astype(vb.dtype)
+        pv = jnp.concatenate(
+            [
+                jax.lax.dot_general(
+                    pb[ki * group:(ki + 1) * group], vb[:, ki],
+                    (((1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                )
+                for ki in range(kv_heads)
+            ],
+            axis=0,
+        )
+        acc_ref[:] = acc_ref[:] * alpha + pv
+        m_ref[:] = m_new
+
+    @pl.when(j == nj - 1)
+    def _finalize():
+        l = jnp.where(l_ref[:] == 0.0, 1.0, l_ref[:])
+        o_ref[0] = (acc_ref[:] / l).reshape(o_ref.shape[1:]).astype(o_ref.dtype)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("scale", "logit_softcap", "interpret")
+)
+def paged_decode_attention(
+    q: jnp.ndarray,
+    k_pages: jnp.ndarray,
+    v_pages: jnp.ndarray,
+    tables: jnp.ndarray,
+    lengths: jnp.ndarray,
+    pads: jnp.ndarray,
+    *,
+    scale: float,
+    logit_softcap: float | None = None,
+    interpret: bool | None = None,
+) -> jnp.ndarray:
+    """One-token GQA attention straight off a paged KV pool.
+
+    q [B, 1, H, D]; k_pages/v_pages [NB, BS, K, D] (ONE layer's pool
+    slab, serve/block_pool.py layout); tables [B, MB] int32 block ids
+    (scratch-0 padded past each row's allocation); lengths [B] — visible
+    slots per row (the current token's K/V already written at slot
+    lengths-1); pads [B] — left-pad slots to skip.  → [B, 1, H, D].
+
+    Row b sees pool slot ``tables[b, pos // BS] * BS + pos % BS`` for
+    logical positions ``pads[b] <= pos < lengths[b]`` — equivalent to
+    gathering the row's blocks into a contiguous [B, MB*BS, K, D] view
+    and running ``decode_attention`` with the matching mask (pinned in
+    tests), but the gather never materializes: each grid step DMAs one
+    pool block found through the scalar-prefetched table, and blocks
+    outside [pads//BS, ceil(lengths/BS)) are skipped entirely.
+
+    This is the serving-engine decode kernel for the live-TPU round
+    (kernel-gated; float pools — the int8 pool currently decodes through
+    the XLA gather path).  interpret=None auto-selects like
+    decode_attention.
+    """
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    b, one, h, d = q.shape
+    assert one == 1, f"paged_decode_attention is q_len=1 only, got {one}"
+    nb_pool, block_s, kh, _ = k_pages.shape
+    if k_pages.dtype == jnp.int8:
+        raise NotImplementedError(
+            "int8 pools decode through the XLA gather path; the paged "
+            "kernel streams float blocks only"
+        )
+    g = h // kh
+    mb = tables.shape[1]
+
+    qf = q.reshape(b, kh, g, d)
+    start = jnp.clip(pads // block_s, 0, jnp.maximum(mb - 1, 0))
+    nb = jnp.clip(-(-lengths // block_s), 1, mb)
+    meta = jnp.stack([start, nb, pads, lengths]).astype(jnp.int32)  # [4, B]
+
+    def _kv_map(bi, j, meta_ref, tables_ref):
+        jj = jnp.minimum(meta_ref[0, bi] + j, meta_ref[1, bi] - 1)
+        return (tables_ref[bi, jj], 0, 0, 0)
+
+    out = pl.pallas_call(
+        functools.partial(
+            _paged_kernel, scale=scale, softcap=logit_softcap,
+            kv_heads=kh, group=g, block_s=block_s,
+        ),
+        out_shape=jax.ShapeDtypeStruct((b, kh, g, d), q.dtype),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(b, mb),
+            in_specs=[
+                pl.BlockSpec(
+                    (1, kh, g, d),
+                    lambda bi, j, meta_ref, tables_ref: (bi, 0, 0, 0),
+                    memory_space=pltpu.VMEM,
+                ),
+                pl.BlockSpec((1, block_s, kh, d), _kv_map,
+                             memory_space=pltpu.VMEM),
+                pl.BlockSpec((1, block_s, kh, d), _kv_map,
+                             memory_space=pltpu.VMEM),
+            ],
+            out_specs=pl.BlockSpec(
+                (1, kh, g, d),
+                lambda bi, j, meta_ref, tables_ref: (bi, 0, 0, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            scratch_shapes=[
+                pltpu.VMEM((h, 1), jnp.float32),
+                pltpu.VMEM((h, 1), jnp.float32),
+                pltpu.VMEM((h, d), jnp.float32),
+            ],
+        ),
+        interpret=interpret,
+    )(meta, tables, qf, k_pages, v_pages)
+
+    return out.reshape(b, 1, h, d)
+
+
 @functools.partial(
     jax.jit,
     static_argnames=("scale", "logit_softcap", "block_s", "interpret"),

@@ -77,6 +77,28 @@ def _probe(kernel: str, backend: str) -> str | None:
                 np.asarray(decode_attention(
                     q, kv, kv, mask, scale=0.125, block_s=64, interpret=False,
                 ))
+        elif kernel == "paged_decode_attention":
+            from llm_np_cp_tpu.ops.pallas.decode_attention import (
+                paged_decode_attention,
+            )
+
+            # serving-pool shapes: 4 blocks of 32 slots, 2-row batch with
+            # block tables permuting the pool — the scalar-prefetch index
+            # map is the layout class only a hardware compile validates;
+            # row 1's pad spans a whole block (start = 1) so the
+            # leading-block-skip path compiles too
+            b, nbp, bs, khd = 2, 4, 32, 64
+            q = jnp.asarray(rng.standard_normal((b, 1, 8, khd)), jnp.bfloat16)
+            pages = jnp.asarray(
+                rng.standard_normal((nbp, bs, 2, khd)), jnp.bfloat16
+            )
+            tables = jnp.asarray([[2, 1], [3, 0]], jnp.int32)
+            lengths = jnp.asarray([40, 63], jnp.int32)
+            pads = jnp.asarray([0, 35], jnp.int32)
+            np.asarray(paged_decode_attention(
+                q, pages, pages, tables, lengths, pads, scale=0.125,
+                interpret=False,
+            ))
         else:
             raise ValueError(f"unknown kernel {kernel!r}")
     except Exception as e:  # noqa: BLE001 — any compile/runtime error gates

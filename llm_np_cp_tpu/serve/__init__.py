@@ -1,0 +1,45 @@
+"""Continuous-batching serving engine over a paged KV block pool.
+
+The layers below this package are batch-job shaped: ``Generator`` takes
+one fixed batch and sizes a contiguous cache slab per call.  Serving
+"heavy traffic from millions of users" (ROADMAP north star) needs the
+request level instead: a queue, admission control, and a shared KV pool
+whose granularity is a *block*, not a whole request — the design argued
+by *Ragged Paged Attention* (PAPERS.md) for TPU inference.
+
+Modules:
+- ``block_pool``  — fixed-size KV blocks in one preallocated slab per
+  layer, a free-list allocator, per-request block tables (int8 blocks
+  reuse cache.quantize_kv/dequantize_kv).
+- ``scheduler``   — continuous batching: admit queued requests into
+  decode slots as others finish, evict-on-OOM with requeue; pure
+  Python/NumPy, so policies are testable without a model.
+- ``engine``      — ``ServeEngine``: jit-stable prefill/decode steps
+  over the packed active batch (K/V gathered through block tables) with
+  per-request streaming callbacks.
+- ``metrics``     — queue depth, TTFT, per-request decode tok/s, pool
+  occupancy, preemptions; exported as a dict.
+"""
+
+from llm_np_cp_tpu.serve.block_pool import BlockPool, FreeList
+from llm_np_cp_tpu.serve.engine import (
+    ServeEngine,
+    pool_geometry,
+    worst_case_slots,
+)
+from llm_np_cp_tpu.serve.metrics import ServeMetrics
+from llm_np_cp_tpu.serve.scheduler import Request, RequestState, Scheduler
+from llm_np_cp_tpu.serve.trace import poisson_trace
+
+__all__ = [
+    "BlockPool",
+    "FreeList",
+    "Request",
+    "RequestState",
+    "Scheduler",
+    "ServeEngine",
+    "ServeMetrics",
+    "poisson_trace",
+    "pool_geometry",
+    "worst_case_slots",
+]

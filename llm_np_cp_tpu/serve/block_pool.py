@@ -1,0 +1,174 @@
+"""Paged KV cache: fixed-size blocks in one preallocated slab per layer.
+
+A contiguous ``KVCache`` reserves ``max_seq_len`` slots per request up
+front — at serving concurrency most of that is empty tail.  The pool
+instead preallocates ONE slab of ``num_blocks`` fixed-size blocks per
+layer and hands requests blocks on demand through a free list; a
+request's cache is its *block table* (list of block ids), so fragments
+left by finished requests are reusable immediately and admission control
+reduces to counting free blocks.
+
+Layout (the contiguous cache's [L, B, S, K, D] with S factored into
+pages):
+
+    k, v: [num_layers, num_blocks, block_size, kv_heads, head_dim]
+
+Block 0 is RESERVED as a scratch block and never allocated: inactive
+decode slots in the engine's fixed-width batch point their tables at it,
+so the packed decode step can write unconditionally (no data-dependent
+shapes) and garbage lands somewhere harmless.
+
+int8 mode mirrors ``KVCache``'s quantized slabs: per-token-per-head
+absmax scales (cache.quantize_kv layout) ride in parallel
+``[L, NB, BS, K]`` f32 pages.
+
+The allocator is host-side Python (a free list) — allocation happens at
+scheduling time, between device steps, never under jit.  The device-side
+pages are a pytree (``PagedKV``) threaded through the engine's jitted
+steps and donated, so slabs update in place.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+
+from llm_np_cp_tpu.config import ModelConfig
+
+
+class FreeList:
+    """LIFO free-list allocator over block ids ``1..num_blocks-1``.
+
+    Block 0 is the reserved scratch block (see module docstring).  LIFO
+    reuse keeps recently-freed blocks hot (their slab pages are most
+    likely still in cache on real hardware).  Pure Python so scheduler
+    policies are testable without any device arrays.
+    """
+
+    def __init__(self, num_blocks: int) -> None:
+        if num_blocks < 2:
+            raise ValueError(
+                f"need at least 2 blocks (1 reserved scratch), got {num_blocks}"
+            )
+        self.num_blocks = num_blocks
+        self._free: list[int] = list(range(num_blocks - 1, 0, -1))
+        self._allocated: set[int] = set()
+
+    @property
+    def num_free(self) -> int:
+        return len(self._free)
+
+    @property
+    def num_allocated(self) -> int:
+        return len(self._allocated)
+
+    @property
+    def capacity(self) -> int:
+        """Allocatable blocks (excludes the reserved scratch block)."""
+        return self.num_blocks - 1
+
+    def alloc(self, n: int) -> list[int] | None:
+        """Pop ``n`` blocks, or None (and no change) if not enough free."""
+        if n < 0:
+            raise ValueError(f"alloc({n})")
+        if n > len(self._free):
+            return None
+        ids = [self._free.pop() for _ in range(n)]
+        self._allocated.update(ids)
+        return ids
+
+    def free(self, ids: list[int]) -> None:
+        for i in ids:
+            if i not in self._allocated:
+                raise ValueError(f"double free or foreign block id {i}")
+            self._allocated.discard(i)
+            self._free.append(i)
+
+
+class PagedKV(NamedTuple):
+    """Device-side pages: the pytree the engine's jitted steps thread
+    through (and donate).  Scales are None for float pools."""
+
+    k: jnp.ndarray  # [L, NB, BS, K, D]
+    v: jnp.ndarray  # [L, NB, BS, K, D]
+    k_scale: jnp.ndarray | None = None  # [L, NB, BS, K] f32 (int8 mode)
+    v_scale: jnp.ndarray | None = None
+
+    @property
+    def quantized(self) -> bool:
+        return self.k_scale is not None
+
+    @property
+    def num_blocks(self) -> int:
+        return self.k.shape[1]
+
+    @property
+    def block_size(self) -> int:
+        return self.k.shape[2]
+
+
+class BlockPool:
+    """Free-list allocator + the device slabs it allocates from.
+
+    ``pages`` is rebound by the engine after every donated step; the
+    pool object itself is host-side bookkeeping only.
+    """
+
+    def __init__(
+        self,
+        config: ModelConfig,
+        num_blocks: int,
+        block_size: int,
+        dtype: jnp.dtype = jnp.bfloat16,
+    ) -> None:
+        if block_size < 8 or block_size % 8:
+            # Mosaic's second-minor alignment rule for the decode kernels;
+            # also keeps gathered views compatible with select_block_s
+            raise ValueError(f"block_size must be a multiple of 8, got {block_size}")
+        self.config = config
+        self.block_size = block_size
+        self.dtype = jnp.dtype(dtype)
+        self.free_list = FreeList(num_blocks)
+        shape = (
+            config.num_hidden_layers,
+            num_blocks,
+            block_size,
+            config.num_key_value_heads,
+            config.head_dim,
+        )
+        quantized = self.dtype == jnp.int8
+        self.pages = PagedKV(
+            k=jnp.zeros(shape, dtype=dtype),
+            v=jnp.zeros(shape, dtype=dtype),
+            k_scale=jnp.zeros(shape[:-1], jnp.float32) if quantized else None,
+            v_scale=jnp.zeros(shape[:-1], jnp.float32) if quantized else None,
+        )
+
+    # -- accounting (delegates; the scheduler talks to these) ----------
+    @property
+    def num_blocks(self) -> int:
+        return self.free_list.num_blocks
+
+    @property
+    def num_free(self) -> int:
+        return self.free_list.num_free
+
+    @property
+    def capacity(self) -> int:
+        return self.free_list.capacity
+
+    @property
+    def occupancy(self) -> float:
+        """Fraction of allocatable blocks currently held by requests."""
+        return self.free_list.num_allocated / max(self.free_list.capacity, 1)
+
+    def blocks_for(self, n_tokens: int) -> int:
+        """Blocks needed to hold ``n_tokens`` cache slots."""
+        return -(-n_tokens // self.block_size)
+
+    def alloc(self, n: int) -> list[int] | None:
+        return self.free_list.alloc(n)
+
+    def free(self, ids: list[int]) -> None:
+        self.free_list.free(ids)

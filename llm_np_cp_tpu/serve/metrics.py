@@ -1,0 +1,136 @@
+"""Serving metrics: what an operator needs to see on one screen.
+
+Collected by ``ServeEngine`` per tick and per request, exported as one
+flat dict (``snapshot()``) so the CLI, bench.py, and tests consume the
+same numbers:
+
+- ``queue_depth_*``        — requests waiting (sampled per tick)
+- ``ttft_s_*``             — arrival (realtime replay) or submit → first
+                             emitted token, per request
+- ``decode_tok_s_*``       — per-request steady decode rate (tokens
+                             after the first / time after first token)
+- ``occupancy_*``          — fraction of allocatable blocks held
+- ``active_slots_*``       — decode slots busy (batch efficiency)
+- ``preemptions``          — evict-on-OOM count (requeues)
+- ``throughput_tok_s``     — total generated tokens / wall span
+
+Percentiles are p50/p90/p99 over whatever was recorded — no windowing;
+a serving front-end would wire these into a real metrics sink
+(ROADMAP follow-up).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any
+
+import numpy as np
+
+from llm_np_cp_tpu.serve.scheduler import Request
+
+
+def _pcts(values: list[float], name: str) -> dict[str, float]:
+    if not values:
+        return {}
+    arr = np.asarray(values, dtype=np.float64)
+    return {
+        f"{name}_p50": float(np.percentile(arr, 50)),
+        f"{name}_p90": float(np.percentile(arr, 90)),
+        f"{name}_p99": float(np.percentile(arr, 99)),
+        f"{name}_mean": float(arr.mean()),
+    }
+
+
+class ServeMetrics:
+    def __init__(self, clock=time.perf_counter) -> None:
+        self.clock = clock
+        self.t_start = clock()
+        self.t_last: float | None = None
+        self.n_submitted = 0
+        self.n_finished = 0
+        self.n_ticks = 0
+        self.preemptions = 0
+        self.total_generated = 0
+        self.ttft_s: list[float] = []
+        self.decode_tok_s: list[float] = []
+        self.queue_depth: list[int] = []
+        self.occupancy: list[float] = []
+        self.active_slots: list[int] = []
+
+    # -- record hooks (engine calls these) -----------------------------
+    def on_submit(self, req: Request) -> None:
+        if self.n_submitted == 0:
+            # wall span starts at first traffic, not engine build — idle
+            # time before the first request must not deflate throughput
+            self.t_start = self.clock()
+        self.n_submitted += 1
+
+    def on_tick(
+        self, *, queue_depth: int, occupancy: float, active_slots: int,
+        preemptions_total: int,
+    ) -> None:
+        self.n_ticks += 1
+        self.t_last = self.clock()
+        self.queue_depth.append(queue_depth)
+        self.occupancy.append(occupancy)
+        self.active_slots.append(active_slots)
+        self.preemptions = preemptions_total
+
+    def on_token(self, req: Request) -> None:
+        self.total_generated += 1
+
+    def on_finish(self, req: Request) -> None:
+        self.n_finished += 1
+        if req.submit_time is not None and req.first_token_time is not None:
+            # realtime replay records the wall arrival, so TTFT includes
+            # the wait before the tick loop noticed the request; the
+            # virtual clock is incommensurable with wall time, so
+            # virtual-mode TTFT is based at submit
+            base = req.extra.get("arrival_wall", req.submit_time)
+            self.ttft_s.append(req.first_token_time - base)
+            n_after_first = len(req.generated) - 1
+            span = (req.finish_time or self.clock()) - req.first_token_time
+            if n_after_first > 0 and span > 0:
+                self.decode_tok_s.append(n_after_first / span)
+
+    # ------------------------------------------------------------------
+    def snapshot(self) -> dict[str, Any]:
+        span = (self.t_last or self.clock()) - self.t_start
+        out: dict[str, Any] = {
+            "submitted": self.n_submitted,
+            "finished": self.n_finished,
+            "ticks": self.n_ticks,
+            "preemptions": self.preemptions,
+            "total_generated_tokens": self.total_generated,
+            "throughput_tok_s": self.total_generated / span if span > 0 else 0.0,
+            "wall_s": span,
+        }
+        out.update(_pcts(self.ttft_s, "ttft_s"))
+        out.update(_pcts(self.decode_tok_s, "decode_tok_s"))
+        out.update(_pcts([float(q) for q in self.queue_depth], "queue_depth"))
+        out.update(_pcts(self.occupancy, "occupancy"))
+        out.update(_pcts([float(a) for a in self.active_slots], "active_slots"))
+        return out
+
+    def format(self) -> str:
+        """One operator-readable block (the CLI prints this)."""
+        s = self.snapshot()
+
+        def g(key: str, fmt: str = "{:.3f}") -> str:
+            return fmt.format(s[key]) if key in s else "-"
+
+        return (
+            f"requests: {s['submitted']} submitted, {s['finished']} finished, "
+            f"{s['preemptions']} preemptions over {s['ticks']} ticks\n"
+            f"throughput: {s['throughput_tok_s']:.1f} tok/s total "
+            f"({s['total_generated_tokens']} tokens in {s['wall_s']:.2f}s)\n"
+            f"ttft_s      p50 {g('ttft_s_p50')}  p90 {g('ttft_s_p90')}  "
+            f"p99 {g('ttft_s_p99')}\n"
+            f"decode_tok_s p50 {g('decode_tok_s_p50', '{:.1f}')}  "
+            f"p90 {g('decode_tok_s_p90', '{:.1f}')}\n"
+            f"queue_depth p50 {g('queue_depth_p50', '{:.1f}')}  "
+            f"p99 {g('queue_depth_p99', '{:.1f}')}; "
+            f"occupancy p50 {g('occupancy_p50', '{:.2f}')}  "
+            f"p99 {g('occupancy_p99', '{:.2f}')}; "
+            f"active_slots mean {g('active_slots_mean', '{:.2f}')}"
+        )

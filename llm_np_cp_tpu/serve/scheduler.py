@@ -1,0 +1,231 @@
+"""Continuous-batching scheduler: requests → decode slots + blocks.
+
+Static batching (``Generator.generate_many``) holds a whole batch until
+its slowest row finishes; the chip idles on every early-EOS row.  Here
+the schedulable unit is one request and one decode tick: queued requests
+are admitted into free decode slots as soon as the block pool can hold
+their prefill (join-on-prefill), and a finished request's slot + blocks
+are reusable at the very next tick.
+
+Policies (deliberately boring — the interesting state is in the pool):
+- **Admission**: strict FIFO.  The head of the queue is admitted when a
+  decode slot is free AND the pool can allocate its prefill blocks while
+  keeping ``decode_reserve`` blocks spare (so a fresh admission cannot
+  instantly OOM the running set).  No queue-jumping → no starvation.
+- **Growth**: before each decode tick every running request whose next
+  token would overflow its allocated blocks gets one more block.
+- **Eviction**: if that allocation fails, the *youngest* running request
+  (most recent admission) is preempted: its blocks return to the pool
+  and it is requeued at the FRONT of the queue with its generated tokens
+  kept.  On readmission it re-prefills prompt+generated (teacher-forced)
+  and continues — with a deterministic sampler this reproduces the
+  uninterrupted output exactly (pinned in tests).  Preempting youngest +
+  requeue-at-front preserves FIFO completion order, so no request
+  starves.
+
+Pure Python/NumPy over the ``FreeList`` accounting interface — no jax —
+so scheduling policies are simulatable and testable without a model
+(tests/test_serve_scheduler.py drives thousands of ticks in
+milliseconds).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+from collections import deque
+from typing import Any, Callable
+
+import numpy as np
+
+
+class RequestState(enum.Enum):
+    QUEUED = "queued"
+    RUNNING = "running"
+    FINISHED = "finished"
+
+
+@dataclasses.dataclass
+class Request:
+    """One generation request and its serving-side bookkeeping."""
+
+    req_id: int
+    prompt: np.ndarray  # [P] int32
+    max_new_tokens: int
+    arrival_time: float = 0.0
+    seed: int = 0
+    # callback(request, token_id, text_delta_or_None) per generated token
+    callback: Callable[["Request", int, str | None], None] | None = None
+
+    # -- scheduler/engine state ---------------------------------------
+    state: RequestState = RequestState.QUEUED
+    generated: list[int] = dataclasses.field(default_factory=list)
+    block_ids: list[int] = dataclasses.field(default_factory=list)
+    pad: int = 0  # left-pad slots in this request's cache region
+    slot: int = -1  # decode slot while RUNNING
+    n_preemptions: int = 0
+    # -- metrics timestamps -------------------------------------------
+    submit_time: float | None = None
+    first_token_time: float | None = None
+    finish_time: float | None = None
+    extra: dict[str, Any] = dataclasses.field(default_factory=dict)
+
+    @property
+    def prompt_len(self) -> int:
+        return int(self.prompt.shape[0])
+
+    @property
+    def total_len(self) -> int:
+        """Prompt + generated tokens (the sequence content length)."""
+        return self.prompt_len + len(self.generated)
+
+    @property
+    def cache_len(self) -> int:
+        """Cache slots used: left pads + content."""
+        return self.pad + self.total_len
+
+    @property
+    def done(self) -> bool:
+        return len(self.generated) >= self.max_new_tokens
+
+    def effective_prompt(self) -> np.ndarray:
+        """Prefill input: the prompt plus any already-generated tokens
+        (teacher-forced after a preemption)."""
+        if not self.generated:
+            return self.prompt
+        return np.concatenate(
+            [self.prompt, np.asarray(self.generated, dtype=np.int32)]
+        )
+
+
+class Scheduler:
+    """Admission + growth + eviction over a block allocator.
+
+    ``allocator`` is anything with the FreeList interface (alloc/free/
+    num_free); ``blocks_for_prefill(req)`` maps a request to the block
+    count its prefill will occupy (the engine's bucketing decides this —
+    the scheduler does not assume a layout).
+    """
+
+    def __init__(
+        self,
+        allocator: Any,
+        *,
+        max_slots: int,
+        block_size: int,
+        blocks_for_prefill: Callable[[Request], int] | None = None,
+        decode_reserve: int = 1,
+    ) -> None:
+        if max_slots < 1:
+            raise ValueError(f"max_slots must be >= 1, got {max_slots}")
+        self.allocator = allocator
+        self.max_slots = max_slots
+        self.block_size = block_size
+        self.decode_reserve = decode_reserve
+        self._blocks_for_prefill = blocks_for_prefill or (
+            lambda req: -(-req.total_len // block_size)
+        )
+        self.queue: deque[Request] = deque()
+        self.running: list[Request] = []  # admission order (oldest first)
+        self.finished: list[Request] = []
+        self._free_slots: list[int] = list(range(max_slots - 1, -1, -1))
+        self.n_preemptions = 0
+
+    # ------------------------------------------------------------------
+    @property
+    def queue_depth(self) -> int:
+        return len(self.queue)
+
+    @property
+    def has_work(self) -> bool:
+        return bool(self.queue or self.running)
+
+    def add(self, req: Request) -> None:
+        req.state = RequestState.QUEUED
+        self.queue.append(req)
+
+    # ------------------------------------------------------------------
+    def admit(self) -> list[Request]:
+        """Admit queue-head requests into free slots while blocks last.
+
+        Allocates each admitted request's prefill blocks (req.block_ids)
+        and assigns its decode slot.  Returns the newly admitted requests
+        (the engine prefills them).
+        """
+        admitted: list[Request] = []
+        while self.queue and self._free_slots:
+            req = self.queue[0]
+            need = self._blocks_for_prefill(req)
+            if self.allocator.num_free < need + self.decode_reserve:
+                break  # strict FIFO: never skip the head
+            ids = self.allocator.alloc(need)
+            if ids is None:
+                break
+            self.queue.popleft()
+            req.block_ids = ids
+            req.slot = self._free_slots.pop()
+            req.state = RequestState.RUNNING
+            self.running.append(req)
+            admitted.append(req)
+        return admitted
+
+    # ------------------------------------------------------------------
+    def ensure_decode_blocks(self) -> list[Request]:
+        """Grow every running request that needs a block for its next
+        token; evict (preempt → requeue) youngest-first on OOM.  A
+        preempted request is fully unwound HERE (blocks freed, slot
+        released, requeued at the front) — the returned list is
+        informational only (metrics/tests); callers must NOT release
+        anything again."""
+        preempted: list[Request] = []
+        # oldest first, so older requests steal from younger ones
+        for req in list(self.running):
+            if req.state is not RequestState.RUNNING:
+                continue  # already preempted below
+            # this tick writes slot cache_len-1, so the allocation is
+            # short only when cache_len EXCEEDS it (at an exact block
+            # boundary the last slot still fits — growing there would
+            # preempt a victim for a block that may never be used)
+            while req.cache_len > len(req.block_ids) * self.block_size:
+                ids = self.allocator.alloc(1)
+                if ids is not None:
+                    req.block_ids.extend(ids)
+                    continue
+                victim = self._pick_victim(req)
+                self._preempt(victim)
+                preempted.append(victim)
+                if victim is req:
+                    break
+        return preempted
+
+    def _pick_victim(self, needing: Request) -> Request:
+        """Always the youngest running request — including the needing
+        request itself when it IS the youngest.  Evicting anything older
+        would invert FIFO completion order and let a young request starve
+        an old one by repeatedly re-evicting it on each growth."""
+        return self.running[-1]
+
+    def _preempt(self, req: Request) -> None:
+        self.allocator.free(req.block_ids)
+        req.block_ids = []
+        req.pad = 0
+        self._release_slot(req)
+        self.running.remove(req)
+        req.state = RequestState.QUEUED
+        self.queue.appendleft(req)
+        req.n_preemptions += 1
+        self.n_preemptions += 1
+
+    # ------------------------------------------------------------------
+    def finish(self, req: Request) -> None:
+        self.allocator.free(req.block_ids)
+        req.block_ids = []
+        self._release_slot(req)
+        self.running.remove(req)
+        req.state = RequestState.FINISHED
+        self.finished.append(req)
+
+    def _release_slot(self, req: Request) -> None:
+        if req.slot >= 0:
+            self._free_slots.append(req.slot)
+            req.slot = -1

@@ -1,0 +1,63 @@
+"""Synthetic request traces for the serving engine.
+
+A serving benchmark needs arrivals, not a batch: the load pattern that
+exposes queueing, admission control, and preemption is requests landing
+at random times with mixed prompt lengths.  The standard open-loop model
+is a Poisson process (exponential inter-arrival gaps at a target
+request rate) — the same workload shape the `serve-bench` CLI
+subcommand, bench.py's serving scenario, and the scheduler tests replay,
+so one definition lives here.
+
+Prompts are random token ids: serving throughput is content-independent
+(decode cost depends on shapes only), and synthetic ids avoid needing a
+tokenizer in CPU tests and bench children.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import numpy as np
+
+
+def poisson_trace(
+    rng: np.random.Generator,
+    n_requests: int,
+    *,
+    rate_rps: float,
+    prompt_len_range: tuple[int, int],
+    max_new_tokens: int | tuple[int, int],
+    vocab_size: int,
+    seed_base: int = 0,
+) -> list[dict[str, Any]]:
+    """``n_requests`` arrivals for ``ServeEngine.replay_trace``.
+
+    rate_rps: mean arrival rate (requests/second); gaps are exponential.
+    prompt_len_range / max_new_tokens: inclusive ranges sampled uniformly
+    (an int ``max_new_tokens`` pins every request to that budget, which
+    the engine-vs-offline parity tests need).
+    """
+    if n_requests < 1:
+        raise ValueError(f"n_requests must be >= 1, got {n_requests}")
+    if rate_rps <= 0:
+        raise ValueError(f"rate_rps must be > 0, got {rate_rps}")
+    lo, hi = prompt_len_range
+    if not (1 <= lo <= hi):
+        raise ValueError(f"bad prompt_len_range {prompt_len_range}")
+    arrivals = np.cumsum(rng.exponential(1.0 / rate_rps, size=n_requests))
+    trace: list[dict[str, Any]] = []
+    for i in range(n_requests):
+        plen = int(rng.integers(lo, hi + 1))
+        if isinstance(max_new_tokens, tuple):
+            mlo, mhi = max_new_tokens
+            mnt = int(rng.integers(mlo, mhi + 1))
+        else:
+            mnt = int(max_new_tokens)
+        trace.append({
+            "arrival_s": float(arrivals[i]),
+            "prompt": rng.integers(1, vocab_size, size=plen, dtype=np.int64)
+            .astype(np.int32),
+            "max_new_tokens": mnt,
+            "seed": seed_base + i,
+        })
+    return trace

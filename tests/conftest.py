@@ -9,12 +9,23 @@ Note: this environment pre-imports jax at interpreter start and pins
 late — we go through ``jax.config`` instead, before any backend initializes.
 """
 
+import os
+
 import jax
 import numpy as np
 import pytest
 
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+try:
+    jax.config.update("jax_num_cpu_devices", 8)
+except AttributeError:
+    # older jax (< 0.5) spells the virtual-device knob as an XLA flag; it
+    # is read when the CPU backend initializes, which conftest import
+    # precedes (jax is imported but no backend is live yet)
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=8"
+    ).strip()
 
 
 @pytest.fixture

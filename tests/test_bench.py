@@ -83,6 +83,7 @@ def test_priority_matches_config_dicts():
         n
         for n in list(bench.DECODE_CONFIGS) + list(bench.SPEC_CONFIGS)
         + list(bench.PREFILL_CONFIGS) + list(bench.RAGGED_CONFIGS)
+        + list(bench.SERVE_CONFIGS)
         if not n.startswith("smoke")
     }
     assert set(bench.PRIORITY) == non_smoke | bench.EXTRA_CHILDREN
@@ -95,7 +96,8 @@ def test_warm_smoke_offline():
     assert res.get("ok") is True, res
     assert set(res["warmed"]) == {n for n in bench.PRIORITY
                                  if n not in bench.SPEC_CONFIGS
-                                 and n not in bench.EXTRA_CHILDREN}
+                                 and n not in bench.EXTRA_CHILDREN
+                                 and n not in bench.SERVE_CONFIGS}
 
 
 def test_warm_limit_covers_top_priority_only():
@@ -106,7 +108,8 @@ def test_warm_limit_covers_top_priority_only():
     warmable = [n for n in bench.PRIORITY
                 if n not in bench.SPEC_CONFIGS
                 and n not in bench.EXTRA_CHILDREN
-                and n not in bench.RAGGED_CONFIGS]
+                and n not in bench.RAGGED_CONFIGS
+                and n not in bench.SERVE_CONFIGS]
     assert res["warmed"] == warmable[:3]
 
 
@@ -118,6 +121,18 @@ def test_ragged_smoke_offline():
     assert res["decode_tok_s_chip_e2e"] > 0
     assert res["prompt_lens"] == [24, 16, 9, 4]
     assert res["cache_capacity"] % 128 == 0
+
+
+def test_serve_smoke_offline():
+    """The serving child (Poisson trace through ServeEngine's paged-pool
+    continuous batching) runs end-to-end on CPU with the tiny model and
+    reports the request-level numbers."""
+    res = bench._spawn("smoke_serve", 600, env={"BENCH_PLATFORM": "cpu"})
+    assert res.get("ok") is True, res
+    assert res["throughput_tok_s"] > 0
+    assert res["ttft_s_p50"] > 0
+    # jit-stable ticks: ONE decode program regardless of trace length
+    assert res["compile_counts"]["decode_step"] == 1
 
 
 def test_decomp_smoke_offline():

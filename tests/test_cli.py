@@ -387,3 +387,44 @@ def test_cli_prompts_file_composes_with_prefill_chunk(fake_load, tmp_path):
         "--dtype=f32", f"--prompts-file={pf}", "--prefill-chunk=3",
     ])
     assert chunked == oneshot
+
+
+def test_cli_speculative_rejects_batch_size_and_early_stop(fake_load):
+    """--batch-size and --early-stop were silently ignored under
+    --speculative (ADVICE r5); the strictness check must reject the
+    combination like the attention-impl flags."""
+    for extra in (["--batch-size=2"], ["--early-stop"]):
+        with pytest.raises(SystemExit, match="does not implement"):
+            cli.run(["--backend=tpu", "--speculative=2", "--max-tokens=2",
+                     "--dtype=f32"] + extra)
+
+
+def test_cli_serve_bench_smoke(fake_load, capsys):
+    """The serve-bench subcommand replays a Poisson trace through
+    ServeEngine on CPU and prints the metrics block."""
+    out = cli.run([
+        "serve-bench", "--requests=4", "--rate=50", "--prompt-len=8",
+        "--max-tokens=3", "--slots=2", "--block-size=8", "--seed=1",
+    ])
+    assert "4 requests" in out
+    assert "throughput" in out and "ttft_s" in out
+    printed = capsys.readouterr().out
+    assert "serve-bench" in printed
+
+
+def test_cli_serve_bench_json_flag(fake_load, capsys):
+    import json
+
+    cli.run([
+        "serve-bench", "--requests=2", "--rate=50", "--prompt-len=8",
+        "--max-tokens=2", "--slots=2", "--block-size=8", "--json",
+    ])
+    last = capsys.readouterr().out.strip().rsplit("\n", 1)[-1]
+    snap = json.loads(last)
+    assert snap["finished"] == 2
+    assert snap["throughput_tok_s"] > 0
+
+
+def test_cli_serve_bench_rejects_bad_block_size(fake_load):
+    with pytest.raises(SystemExit, match="multiple of 8"):
+        cli.run(["serve-bench", "--block-size=12"])

@@ -198,3 +198,103 @@ def test_ragged_batch_parity():
                   cache_dtype=jnp.float32,
                   decode_attn_impl="flash_decode").generate_ragged(prompts, 6).tokens
     np.testing.assert_array_equal(a, b)
+
+
+# ---------------------------------------------------------------------------
+# Paged (block-table) variant: the serving-pool kernel (serve/block_pool.py
+# layout).  Equivalence contract from its docstring: row b attends to pool
+# slot tables[b, pos // BS] * BS + pos % BS for pads[b] <= pos < lengths[b]
+# — i.e. gathering the row's blocks contiguous and masking must match.
+# ---------------------------------------------------------------------------
+
+def _paged_reference(q, pages_k, pages_v, tables, lengths, pads, *,
+                     scale, logit_softcap=None):
+    b, mb = tables.shape
+    bs = pages_k.shape[1]
+    kh, d = pages_k.shape[-2:]
+    gk = pages_k[tables].reshape(b, mb * bs, kh, d)
+    gv = pages_v[tables].reshape(b, mb * bs, kh, d)
+    pos = jnp.arange(mb * bs)[None, :]
+    mask = (pos >= pads[:, None]) & (pos < lengths[:, None])
+    return gqa_attention(q, gk, gv, mask[:, None, :], scale=scale,
+                         logit_softcap=logit_softcap)
+
+
+@pytest.mark.parametrize("h,kh", [(4, 4), (8, 2), (4, 1)])
+def test_paged_matches_gathered_contiguous(h, kh):
+    from llm_np_cp_tpu.ops.pallas.decode_attention import paged_decode_attention
+
+    rng = np.random.default_rng(h * 7 + kh)
+    b, d, nbp, bs, mb = 3, 16, 8, 16, 4
+    q = _rand(rng, (b, 1, h, d))
+    pages_k = _rand(rng, (nbp, bs, kh, d))
+    pages_v = _rand(rng, (nbp, bs, kh, d))
+    # permuted tables with scratch-0 padding past each row's allocation
+    tables = jnp.asarray([[1, 2, 3, 0], [4, 5, 0, 0], [7, 6, 5, 4]], jnp.int32)
+    lengths = jnp.asarray([40, 17, 64], jnp.int32)  # mid-block, 1-past, full
+    pads = jnp.asarray([3, 0, 10], jnp.int32)
+    want = _paged_reference(q, pages_k, pages_v, tables, lengths, pads,
+                            scale=d**-0.5)
+    got = paged_decode_attention(q, pages_k, pages_v, tables, lengths, pads,
+                                 scale=d**-0.5)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5)
+
+
+def test_paged_softcap_parity():
+    from llm_np_cp_tpu.ops.pallas.decode_attention import paged_decode_attention
+
+    rng = np.random.default_rng(0)
+    b, h, kh, d, nbp, bs, mb = 2, 4, 2, 8, 6, 8, 3
+    q = _rand(rng, (b, 1, h, d)) * 3
+    pages_k = _rand(rng, (nbp, bs, kh, d)) * 3
+    pages_v = _rand(rng, (nbp, bs, kh, d))
+    tables = jnp.asarray([[5, 1, 2], [3, 4, 0]], jnp.int32)
+    lengths = jnp.asarray([24, 9], jnp.int32)
+    pads = jnp.asarray([2, 0], jnp.int32)
+    want = _paged_reference(q, pages_k, pages_v, tables, lengths, pads,
+                            scale=0.5, logit_softcap=20.0)
+    got = paged_decode_attention(q, pages_k, pages_v, tables, lengths, pads,
+                                 scale=0.5, logit_softcap=20.0)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5)
+
+
+def test_paged_rejects_int8_pool():
+    """int8 pools decode through the XLA gather path for now; the kernel
+    must refuse rather than misread quantized blocks as floats."""
+    from llm_np_cp_tpu.ops.pallas.decode_attention import paged_decode_attention
+
+    q = jnp.zeros((1, 1, 4, 8))
+    pages = jnp.zeros((2, 8, 2, 8), jnp.int8)
+    with pytest.raises(NotImplementedError, match="int8"):
+        paged_decode_attention(
+            q, pages, pages, jnp.zeros((1, 1), jnp.int32),
+            jnp.asarray([4], jnp.int32), jnp.asarray([0], jnp.int32),
+            scale=0.35,
+        )
+
+
+def test_paged_leading_block_skip_parity():
+    """Rows whose left pads span WHOLE blocks (start = pads // BS > 0):
+    the kernel's grid clamp (start + j < nb) and the scalar-prefetch
+    index map both begin at the first visible block, and nothing else in
+    the suite exercises start > 0 — yet the engine's bench config
+    (prefill_chunk = 2*block_size) routinely produces pads >= BS."""
+    from llm_np_cp_tpu.ops.pallas.decode_attention import paged_decode_attention
+
+    rng = np.random.default_rng(42)
+    b, h, kh, d, nbp, bs = 3, 8, 2, 16, 10, 8
+    q = _rand(rng, (b, 1, h, d))
+    pages_k = _rand(rng, (nbp, bs, kh, d))
+    pages_v = _rand(rng, (nbp, bs, kh, d))
+    tables = jnp.asarray(
+        [[1, 2, 3, 4], [5, 6, 7, 0], [9, 8, 7, 6]], jnp.int32
+    )
+    # start blocks 1, 2, 3: mid-block pad, exact-boundary pad, and a row
+    # whose single visible block is its LAST
+    lengths = jnp.asarray([30, 24, 32], jnp.int32)
+    pads = jnp.asarray([9, 16, 25], jnp.int32)
+    want = _paged_reference(q, pages_k, pages_v, tables, lengths, pads,
+                            scale=d**-0.5)
+    got = paged_decode_attention(q, pages_k, pages_v, tables, lengths, pads,
+                                 scale=d**-0.5)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5)

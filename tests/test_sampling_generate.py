@@ -195,3 +195,28 @@ def test_stream_text_incremental_detok(tiny_model):
     )
     assert "".join(chunks) == final
     assert len(final) == 6
+
+
+def test_early_stop_reports_executed_steps(tiny_model):
+    """GenerateResult.steps is the decode-loop trip count actually run:
+    the full budget for the fixed-trip scan, fewer when early_stop exits
+    at all-done — the denominator of decode_tokens_per_s (the budget
+    overstated rates for early-stopped batches, ADVICE r5)."""
+    cfg, params, params_np = tiny_model
+    prompt = np.array([5, 1, 4, 1, 5], dtype=np.int32)
+    plain = greedy_generate_np(params_np, prompt, cfg, max_new_tokens=12)
+    stop = plain[4]
+
+    scan_gen = Generator(params, cfg, sampler=Sampler(kind="greedy"),
+                         stop_tokens=(stop,), cache_dtype=jnp.float32)
+    res = scan_gen.generate(prompt, max_new_tokens=12)
+    assert res.steps == 11  # fixed-trip: budget minus the prefill token
+
+    early_gen = Generator(params, cfg, sampler=Sampler(kind="greedy"),
+                          stop_tokens=(stop,), cache_dtype=jnp.float32,
+                          early_stop=True)
+    res_e = early_gen.generate(prompt, max_new_tokens=12)
+    # exits right after the step whose token is the stop token
+    first_stop = int(np.argmax(res.tokens[0] == stop))
+    assert res_e.steps == first_stop < 11
+    np.testing.assert_array_equal(res_e.tokens, res.tokens)

@@ -1,0 +1,121 @@
+"""Block-pool invariants: the allocator under the serving engine.
+
+The free list is the admission-control ground truth — a bug here either
+leaks pool capacity (throughput collapses under load) or double-books a
+block (two requests silently corrupt each other's KV).  Pure host-side
+tests; the device-slab parity lives in test_serve_engine.py.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from llm_np_cp_tpu.config import tiny_config
+from llm_np_cp_tpu.serve.block_pool import BlockPool, FreeList
+
+
+def test_freelist_alloc_free_roundtrip():
+    fl = FreeList(8)
+    assert fl.capacity == 7 and fl.num_free == 7
+    ids = fl.alloc(3)
+    assert ids is not None and len(ids) == 3 and len(set(ids)) == 3
+    assert fl.num_free == 4 and fl.num_allocated == 3
+    fl.free(ids)
+    assert fl.num_free == 7 and fl.num_allocated == 0
+
+
+def test_freelist_never_hands_out_scratch_block():
+    fl = FreeList(8)
+    ids = fl.alloc(7)  # drain the whole pool
+    assert ids is not None and 0 not in ids
+    assert sorted(ids) == list(range(1, 8))
+
+
+def test_freelist_oversubscribe_returns_none_without_change():
+    fl = FreeList(4)
+    assert fl.alloc(4) is None  # capacity is 3 (block 0 reserved)
+    assert fl.num_free == 3 and fl.num_allocated == 0
+    got = fl.alloc(3)
+    assert got is not None
+    assert fl.alloc(1) is None
+    assert fl.num_allocated == 3
+
+
+def test_freelist_double_free_and_foreign_free_raise():
+    fl = FreeList(4)
+    ids = fl.alloc(1)
+    fl.free(ids)
+    with pytest.raises(ValueError):
+        fl.free(ids)
+    with pytest.raises(ValueError):
+        fl.free([0])  # the scratch block is never allocated
+
+
+def test_freelist_fragmentation_reuse():
+    """Interleaved frees leave holes; any n <= num_free must remain
+    allocatable (a paged pool has no external fragmentation by
+    construction — this pins that the accounting agrees)."""
+    fl = FreeList(16)
+    held = [fl.alloc(1) for _ in range(15)]
+    holes = held[::2]
+    for h in holes:
+        fl.free(h)
+    assert fl.num_free == len(holes)
+    again = fl.alloc(len(holes))
+    assert again is not None
+    assert sorted(again) == sorted(i for h in holes for i in h)
+
+
+def test_freelist_lifo_reuse():
+    """Most recently freed block is reallocated first (keeps hot pages
+    hot on real hardware)."""
+    fl = FreeList(8)
+    a = fl.alloc(2)
+    fl.free([a[1]])
+    fl.free([a[0]])
+    assert fl.alloc(1) == [a[0]]
+    assert fl.alloc(1) == [a[1]]
+
+
+def test_block_pool_shapes_and_occupancy():
+    cfg = tiny_config("llama")
+    pool = BlockPool(cfg, num_blocks=6, block_size=8, dtype=jnp.float32)
+    assert pool.pages.k.shape == (
+        cfg.num_hidden_layers, 6, 8, cfg.num_key_value_heads, cfg.head_dim
+    )
+    assert pool.pages.v.shape == pool.pages.k.shape
+    assert not pool.pages.quantized
+    assert pool.occupancy == 0.0
+    ids = pool.alloc(2)
+    assert pool.occupancy == pytest.approx(2 / 5)
+    pool.free(ids)
+    assert pool.occupancy == 0.0
+
+
+def test_block_pool_blocks_for_rounds_up():
+    cfg = tiny_config("llama")
+    pool = BlockPool(cfg, num_blocks=4, block_size=8)
+    assert pool.blocks_for(1) == 1
+    assert pool.blocks_for(8) == 1
+    assert pool.blocks_for(9) == 2
+    assert pool.blocks_for(17) == 3
+
+
+def test_block_pool_int8_pages_carry_scales():
+    cfg = tiny_config("llama")
+    pool = BlockPool(cfg, num_blocks=4, block_size=8, dtype=jnp.int8)
+    assert pool.pages.quantized
+    assert pool.pages.k.dtype == jnp.int8
+    assert pool.pages.k_scale.shape == pool.pages.k.shape[:-1]
+    assert pool.pages.k_scale.dtype == jnp.float32
+    assert pool.pages.v_scale.shape == pool.pages.v.shape[:-1]
+
+
+def test_block_pool_rejects_bad_geometry():
+    cfg = tiny_config("llama")
+    with pytest.raises(ValueError):
+        BlockPool(cfg, num_blocks=4, block_size=12)  # not a multiple of 8
+    with pytest.raises(ValueError):
+        BlockPool(cfg, num_blocks=4, block_size=4)  # below Mosaic minimum
+    with pytest.raises(ValueError):
+        FreeList(1)  # nothing allocatable beside the scratch block

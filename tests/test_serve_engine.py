@@ -1,0 +1,214 @@
+"""ServeEngine end-to-end: continuous batching must be output-invisible.
+
+The whole serving layer (queueing, paged pool, packed decode, eviction)
+is legitimate only if a request cannot tell it shared the machine: every
+request's greedy tokens must equal ``Generator.generate_ragged`` run
+offline on the same prompt (the acceptance criterion for the serve/
+subsystem), whether its KV lived in contiguous slabs or scattered
+blocks, bf16/f32 or int8, interrupted by preemption or not.
+
+CPU backend, tiny fixture; the compile-counter assertions ride along so
+the parity traffic doubles as the jit-stability evidence.
+"""
+
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+sys.path.insert(0, __file__.rsplit("/tests/", 1)[0])
+from llm_np_cp_tpu.config import tiny_config
+from llm_np_cp_tpu.generate import Generator
+from llm_np_cp_tpu.models.transformer import init_params
+from llm_np_cp_tpu.ops.sampling import Sampler
+from llm_np_cp_tpu.serve import ServeEngine, poisson_trace
+from tools.compile_counter import assert_serve_compiles_bounded
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    cfg = tiny_config("llama")
+    params = init_params(jax.random.PRNGKey(0), cfg, dtype=jnp.float32)
+    return cfg, params
+
+
+def _offline_tokens(gen: Generator, req) -> list[int]:
+    res = gen.generate_ragged([req.prompt], req.max_new_tokens, seed=req.seed)
+    return [int(t) for t in np.asarray(res.tokens)[0][: req.max_new_tokens]]
+
+
+def _assert_parity(engine: ServeEngine, cfg, params, cache_dtype) -> None:
+    gen = Generator(
+        params, cfg, sampler=Sampler(kind="greedy"), cache_dtype=cache_dtype
+    )
+    assert engine.scheduler.finished, "nothing finished — bad test setup"
+    for req in engine.scheduler.finished:
+        assert req.generated == _offline_tokens(gen, req), (
+            f"request {req.req_id} (preempted {req.n_preemptions}x) diverged "
+            "from the offline run"
+        )
+
+
+def test_trace_parity_32_requests_and_bounded_compiles(tiny):
+    """The acceptance criterion: a 32-request Poisson trace through the
+    engine produces per-request greedy tokens identical to offline
+    ``generate_ragged``, and the jitted steps compile once per distinct
+    phase shape — never per tick."""
+    cfg, params = tiny
+    engine = ServeEngine(
+        params, cfg, sampler=Sampler(kind="greedy"),
+        max_slots=4, num_blocks=48, block_size=8, max_seq_len=64,
+        cache_dtype=jnp.float32,
+    )
+    rng = np.random.default_rng(0)
+    trace = poisson_trace(
+        rng, 32, rate_rps=40.0, prompt_len_range=(3, 14),
+        max_new_tokens=6, vocab_size=cfg.vocab_size,
+    )
+    snap = engine.replay_trace(trace)
+    assert snap["finished"] == 32
+    _assert_parity(engine, cfg, params, jnp.float32)
+
+    # distinct prefill shapes == distinct block allocations at prefill
+    # time (no preemptions here, so each request prefilled its prompt
+    # rounded up to whole chunks)
+    chunk = engine.prefill_chunk
+    shapes = {
+        engine.pool.blocks_for(-(-r.prompt_len // chunk) * chunk)
+        for r in engine.scheduler.finished
+    }
+    assert engine.scheduler.n_preemptions == 0
+    assert_serve_compiles_bounded(engine, distinct_prefill_shapes=len(shapes))
+    counts = engine.compile_counts()
+    assert counts["decode_step"] == 1
+    assert snap["ticks"] > counts["decode_step"] + counts["prefill_step"]
+
+
+def test_eviction_requeue_parity(tiny):
+    """A pool too small for the running set forces evict→requeue; the
+    re-prefilled (teacher-forced) request must still produce the exact
+    uninterrupted token sequence."""
+    cfg, params = tiny
+    engine = ServeEngine(
+        params, cfg, sampler=Sampler(kind="greedy"),
+        max_slots=2, num_blocks=6, block_size=8, max_seq_len=64,
+        cache_dtype=jnp.float32,
+    )
+    rng = np.random.default_rng(7)
+    for n in (4, 5, 3):
+        engine.submit(rng.integers(1, cfg.vocab_size, size=n), 20)
+    engine.run_until_complete()
+    assert engine.scheduler.n_preemptions > 0, (
+        "pool was not tight enough to exercise eviction"
+    )
+    assert len(engine.scheduler.finished) == 3
+    _assert_parity(engine, cfg, params, jnp.float32)
+    # preempted blocks all returned
+    assert engine.pool.free_list.num_allocated == 0
+
+
+def test_int8_block_pool_parity(tiny):
+    """int8 pool blocks (quantize on write, dequantize on gather — the
+    cache.quantize_kv discipline) must decode exactly like the
+    contiguous int8 ``KVCache``: same greedy tokens on the tiny
+    fixture."""
+    cfg, params = tiny
+    engine = ServeEngine(
+        params, cfg, sampler=Sampler(kind="greedy"),
+        max_slots=3, num_blocks=16, block_size=8, max_seq_len=64,
+        cache_dtype=jnp.int8,
+    )
+    assert engine.pool.pages.quantized
+    rng = np.random.default_rng(11)
+    for n in (6, 11, 4):
+        engine.submit(rng.integers(1, cfg.vocab_size, size=n), 5)
+    engine.run_until_complete()
+    assert len(engine.scheduler.finished) == 3
+    _assert_parity(engine, cfg, params, jnp.int8)
+
+
+def test_streaming_callbacks_per_request(tiny):
+    """Each generated token reaches the request's callback in order, and
+    detokenized deltas concatenate to the full decoded text."""
+    cfg, params = tiny
+
+    class Tok:
+        def decode(self, ids, skip_special_tokens=True):
+            return "".join(chr(97 + (int(i) % 26)) for i in ids)
+
+    engine = ServeEngine(
+        params, cfg, sampler=Sampler(kind="greedy"),
+        max_slots=2, num_blocks=16, block_size=8, max_seq_len=64,
+        cache_dtype=jnp.float32, tokenizer=Tok(),
+    )
+    got: dict[int, list] = {}
+    text: dict[int, str] = {}
+
+    def cb(req, token, delta):
+        got.setdefault(req.req_id, []).append(token)
+        if delta:
+            text[req.req_id] = text.get(req.req_id, "") + delta
+
+    rng = np.random.default_rng(2)
+    reqs = [
+        engine.submit(rng.integers(1, cfg.vocab_size, size=n), 4, callback=cb)
+        for n in (3, 7)
+    ]
+    engine.run_until_complete()
+    for req in reqs:
+        assert got[req.req_id] == req.generated
+        assert text[req.req_id] == Tok().decode(req.generated)
+
+
+def test_submit_rejects_impossible_requests(tiny):
+    cfg, params = tiny
+    engine = ServeEngine(
+        params, cfg, max_slots=1, num_blocks=4, block_size=8, max_seq_len=24,
+        cache_dtype=jnp.float32,
+    )
+    with pytest.raises(ValueError, match="max_seq_len"):
+        engine.submit(np.arange(1, 20, dtype=np.int32), 30)
+    with pytest.raises(ValueError, match="empty prompt"):
+        engine.submit(np.zeros(0, np.int32), 4)
+    with pytest.raises(ValueError, match="max_new_tokens"):
+        engine.submit(np.asarray([5], np.int32), 0)
+
+
+def test_submit_rejects_unadmittable_request(tiny):
+    """The submit check must mirror the scheduler's admission rule
+    (prefill need + decode reserve): with prefill_chunk=100 over 64-slot
+    blocks, a 150-token prompt fits max_seq_len and the raw pool, but
+    its 200-wide prefill needs 4 blocks + 1 reserve > 4 allocatable —
+    it would starve the FIFO head forever if accepted."""
+    cfg, params = tiny
+    engine = ServeEngine(
+        params, cfg, max_slots=1, num_blocks=5, block_size=64,
+        max_seq_len=256, prefill_chunk=100, cache_dtype=jnp.float32,
+    )
+    with pytest.raises(ValueError, match="pool capacity"):
+        engine.submit(np.arange(1, 151, dtype=np.int32), 1)
+    # a request whose worst-case admission leaves the reserve free is in
+    engine.submit(np.arange(1, 11, dtype=np.int32), 2)
+
+
+def test_metrics_snapshot_shape(tiny):
+    cfg, params = tiny
+    engine = ServeEngine(
+        params, cfg, sampler=Sampler(kind="greedy"),
+        max_slots=2, num_blocks=16, block_size=8, max_seq_len=64,
+        cache_dtype=jnp.float32,
+    )
+    rng = np.random.default_rng(4)
+    trace = poisson_trace(
+        rng, 5, rate_rps=100.0, prompt_len_range=(2, 10),
+        max_new_tokens=3, vocab_size=cfg.vocab_size,
+    )
+    snap = engine.replay_trace(trace)
+    assert snap["submitted"] == snap["finished"] == 5
+    assert snap["total_generated_tokens"] == 15
+    assert snap["throughput_tok_s"] > 0
+    assert snap["ttft_s_p50"] > 0
+    assert 0 <= snap["occupancy_p99"] <= 1
+    assert "tok/s" in engine.metrics.format()

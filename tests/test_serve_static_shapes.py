@@ -1,0 +1,81 @@
+"""The serve/ static-shape lint (tools/compile_counter.py).
+
+A recompile inside the serving tick loop is a multi-second stall for
+every queued request, so the engine's contract is: after one warm pass
+over the workload's phase shapes, further traffic triggers ZERO backend
+compiles.  Two independent probes pin it — the engine's own per-program
+jit cache sizes, and a process-wide ``jax.monitoring`` listener that
+would also catch an accidentally-unjitted (retracing) code path.
+"""
+
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+sys.path.insert(0, __file__.rsplit("/tests/", 1)[0])
+from llm_np_cp_tpu.config import tiny_config
+from llm_np_cp_tpu.models.transformer import init_params
+from llm_np_cp_tpu.ops.sampling import Sampler
+from llm_np_cp_tpu.serve import ServeEngine
+from tools.compile_counter import CompileCounter, assert_serve_compiles_bounded
+
+
+def _engine(cfg, params):
+    return ServeEngine(
+        params, cfg, sampler=Sampler(kind="greedy"),
+        max_slots=2, num_blocks=24, block_size=8, max_seq_len=64,
+        cache_dtype=jnp.float32,
+    )
+
+
+def _drive(engine, cfg, lens, max_new=5, seed0=0):
+    rng = np.random.default_rng(seed0)
+    for i, n in enumerate(lens):
+        engine.submit(rng.integers(1, cfg.vocab_size, size=n), max_new,
+                      seed=seed0 + i)
+    engine.run_until_complete()
+
+
+def test_steady_state_ticks_compile_nothing():
+    """Warm pass covers the phase shapes; a second batch of requests
+    reusing those shapes (different lengths, same block-count buckets)
+    must run with zero new backend compiles."""
+    cfg = tiny_config("llama")
+    params = init_params(jax.random.PRNGKey(0), cfg, dtype=jnp.float32)
+    engine = _engine(cfg, params)
+    # warm: 1-block and 2-block prefills (block_size=8, chunk=8)
+    _drive(engine, cfg, lens=(4, 12), seed0=0)
+    warm_counts = dict(engine.compile_counts())
+
+    counter = CompileCounter()
+    with counter.watch():
+        _drive(engine, cfg, lens=(6, 3, 10, 15, 7), seed0=100)
+    assert counter.count == 0, (
+        f"steady-state serving compiled: {counter.events}"
+    )
+    assert engine.compile_counts() == warm_counts
+
+
+def test_compile_counts_bounded_by_phase_shapes():
+    """The per-program contract: decode/sample/prefill compile once (the
+    temp prefill cache has a fixed capacity), scatter at most once per
+    distinct prefill block count, regardless of how many requests or
+    ticks ran."""
+    cfg = tiny_config("llama")
+    params = init_params(jax.random.PRNGKey(1), cfg, dtype=jnp.float32)
+    engine = _engine(cfg, params)
+    lens = (3, 5, 9, 14, 2, 11, 8, 16)
+    _drive(engine, cfg, lens=lens, seed0=0)
+    chunk = engine.prefill_chunk
+    shapes = {
+        engine.pool.blocks_for(-(-r.prompt_len // chunk) * chunk)
+        for r in engine.scheduler.finished
+    }
+    assert engine.scheduler.n_preemptions == 0
+    assert_serve_compiles_bounded(engine, distinct_prefill_shapes=len(shapes))
+    counts = engine.compile_counts()
+    assert counts["decode_step"] == 1
+    assert counts["sample_first"] == 1
+    assert counts["prefill_step"] == 1

@@ -1,0 +1,136 @@
+"""Compile-counter: the serve/ static-shape lint.
+
+The serving engine's whole design rests on jit-stable steps: a tick must
+never retrace (a recompile mid-traffic is a multi-second stall for every
+queued request).  This module gives tests and CI two independent probes:
+
+- ``CompileCounter`` — a ``jax.monitoring`` listener counting backend
+  compile events process-wide; wrap a block of ticks and assert zero new
+  compiles.
+- ``assert_serve_compiles_bounded(engine)`` — checks the engine's own
+  per-program compile counts (``ServeEngine.compile_counts()``) against
+  the static-shape contract: decode/sample/prefill compile ONCE (the
+  temp prefill cache is padded to a fixed capacity), scatter once per
+  distinct prefill block count (phase shapes), never per tick.
+
+Run from tests (tests/test_serve_static_shapes.py); usable standalone:
+
+    python tools/compile_counter.py   # self-check on a tiny synthetic trace
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Iterator
+
+# Event keys that indicate an XLA computation was compiled.  jax renamed
+# these across versions; match loosely on purpose.
+_COMPILE_MARKERS = ("compile", "lowering")
+
+
+class CompileCounter:
+    """Counts jax compile-ish monitoring events while active."""
+
+    def __init__(self) -> None:
+        self.events: list[str] = []
+
+    @property
+    def count(self) -> int:
+        return len(self.events)
+
+    def _listener(self, event: str, **kw) -> None:
+        if any(m in event for m in _COMPILE_MARKERS):
+            self.events.append(event)
+
+    @contextlib.contextmanager
+    def watch(self) -> Iterator["CompileCounter"]:
+        from jax._src import monitoring
+
+        monitoring.register_event_listener(self._listener)
+        try:
+            yield self
+        finally:
+            # jax's monitoring registry has no public remove in older
+            # versions; fall back to leaving a dead listener if needed
+            try:
+                monitoring._unregister_event_listener_by_callback(  # type: ignore[attr-defined]
+                    self._listener
+                )
+            except Exception:
+                pass
+
+
+def assert_serve_compiles_bounded(engine, *, distinct_prefill_shapes: int) -> None:
+    """The static-shape contract for every serve/ jitted step.
+
+    distinct_prefill_shapes: how many distinct prefill block counts the
+    driven workload legitimately produced (== number of distinct temp
+    cache capacities).  Anything above these bounds means a step's
+    shapes depend on per-tick state — the exact bug this lint exists to
+    catch.
+    """
+    counts = engine.compile_counts()
+    problems = []
+    if counts["decode_step"] > 1:
+        problems.append(
+            f"decode_step compiled {counts['decode_step']}x (must be 1: "
+            "packed batch/table/pool shapes are all static)"
+        )
+    if counts["sample_first"] > 1:
+        problems.append(
+            f"sample_first compiled {counts['sample_first']}x (must be 1)"
+        )
+    if counts["prefill_step"] > 1:
+        problems.append(
+            f"prefill_step compiled {counts['prefill_step']}x (must be 1: "
+            "the temp prefill cache is padded to a fixed capacity so "
+            "prompt-length buckets never retrace the model)"
+        )
+    if counts["scatter_prefill"] > distinct_prefill_shapes:
+        problems.append(
+            f"scatter_prefill compiled {counts['scatter_prefill']}x for "
+            f"{distinct_prefill_shapes} distinct prefill shapes "
+            "(must be <= one per phase shape, never per tick)"
+        )
+    if any(v < 0 for v in counts.values()):
+        problems.append(
+            f"compile counts unavailable on this jax version: {counts}"
+        )
+    if problems:
+        raise AssertionError(
+            "serve/ static-shape lint failed:\n  " + "\n  ".join(problems)
+        )
+
+
+def _self_check() -> None:
+    import os
+    import sys
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    jax.config.update("jax_platforms", "cpu")
+    from llm_np_cp_tpu.config import tiny_config
+    from llm_np_cp_tpu.models.transformer import init_params
+    from llm_np_cp_tpu.ops.sampling import Sampler
+    from llm_np_cp_tpu.serve.engine import ServeEngine
+
+    cfg = tiny_config("llama")
+    params = init_params(jax.random.PRNGKey(0), cfg, dtype=jnp.float32)
+    eng = ServeEngine(
+        params, cfg, sampler=Sampler(kind="greedy"), max_slots=2,
+        num_blocks=16, block_size=8, max_seq_len=64, cache_dtype=jnp.float32,
+    )
+    rng = np.random.default_rng(0)
+    for n in (5, 9, 5, 13):
+        eng.submit(rng.integers(1, 200, size=n), 6)
+    eng.run_until_complete()
+    shapes = {-(-(-(-n // 8) * 8) // 8) for n in (5, 9, 5, 13)}
+    assert_serve_compiles_bounded(engine=eng, distinct_prefill_shapes=len(shapes))
+    print(f"compile counts OK: {eng.compile_counts()}")
+
+
+if __name__ == "__main__":
+    _self_check()
