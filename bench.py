@@ -153,6 +153,18 @@ SERVE_CONFIGS = {
     "serve_poisson_bs8": dict(model="llama1b", requests=32, rate=16.0,
                               prompt_len=512, max_tokens=64, slots=8,
                               block_size=128),
+    # shared-prefix workload: 32 requests drawn from 8 distinct prompts
+    # (4 repeats each) with the refcounted prefix cache on — hits skip
+    # whole prefill chunks, so TTFT and prefill dispatch counts are the
+    # observable, alongside the gather-vs-paged decode split.
+    # extra_blocks: retention headroom beyond the worst-case sizing —
+    # cache entries are reclaimed LRU whenever the free list runs short,
+    # so a worst-case-tight pool would evict every entry before its
+    # twin prompt arrives (8 prompts x <=4 shareable blocks each)
+    "serve_prefix_shared": dict(model="llama1b", requests=32, rate=16.0,
+                                prompt_len=512, max_tokens=64, slots=8,
+                                block_size=128, distinct_prompts=8,
+                                prefix_cache=True, extra_blocks=32),
     "smoke_serve": dict(model="tiny", requests=8, rate=100.0, prompt_len=16,
                         max_tokens=6, slots=2, block_size=8),
 }
@@ -191,6 +203,7 @@ PRIORITY = [
     "ragged_bs8_xla",     # ragged decode: the kernel's structural win case
     "ragged_bs8_fdec",
     "serve_poisson_bs8",  # continuous-batching serving engine (serve/)
+    "serve_prefix_shared",  # prefix-cache reuse + gather-vs-paged decode
     "gemma2_2b_bs8",      # Gemma north-star number (VERDICT task 3)
     "int8_bs8",           # roofline-gap anchor (VERDICT task 6)
     "int8a8_bs8",         # W8A8 int8-MXU einsums vs that anchor
@@ -231,8 +244,11 @@ TIMEOUTS = {
     "ragged_bs8_xla": 600,  # 2 prefill + 2 loop compiles + 3 rep pairs
     "ragged_bs8_fdec": 600,
     # ~290 host-driven device dispatches (32 prefills + ~256 decode
-    # ticks) + 4 program compiles; per-tick host latency dominates
-    "serve_poisson_bs8": 600,
+    # ticks) + 4 program compiles; per-tick host latency dominates —
+    # and when the paged probe passes the trace replays ONCE PER IMPL
+    # (gather + paged), roughly doubling the measured span
+    "serve_poisson_bs8": 850,
+    "serve_prefix_shared": 850,
     # prefill-dominated: the marginal measurement's extra prefill+half
     # decode per rep nearly doubles measured-phase wall time
     "llama3b_seq2048_bs8": 700,
@@ -651,7 +667,14 @@ def run_serve_config(name: str) -> dict:
     (TTFT percentiles, per-request decode tok/s, preemptions, pool
     occupancy) that the batch-shaped configs above cannot measure.
     Wall-clock here includes scheduler/host time — that is the point:
-    serving throughput is what a user-facing deployment gets."""
+    serving throughput is what a user-facing deployment gets.
+
+    When the paged (block-table-native, zero-gather) decode kernel
+    passes the Mosaic compile probe, the SAME trace replays once per
+    impl — ``attn_impl=gather`` vs ``attn_impl=paged`` on identical
+    arrivals is the head-to-head the ROADMAP follow-up asked for; the
+    flat headline keys report the paged run when available."""
+    import jax.numpy as jnp
     import numpy as np
 
     from llm_np_cp_tpu.ops.sampling import Sampler
@@ -661,6 +684,10 @@ def run_serve_config(name: str) -> dict:
     spec = SERVE_CONFIGS[name]
     config, params = _build_model(spec["model"], tag=name, t0=t0)
     _phase(name, "params_built", t0)
+    from llm_np_cp_tpu.ops.pallas.support import (
+        kernel_error,
+        paged_kernel_name,
+    )
     from llm_np_cp_tpu.serve.engine import pool_geometry
 
     bs = spec["block_size"]
@@ -669,16 +696,17 @@ def run_serve_config(name: str) -> dict:
         spec["prompt_len"], spec["max_tokens"], spec["slots"], bs,
         prefill_chunk=chunk,
     )
-    num_blocks = spec.get("num_blocks", sized_blocks)
-    engine = ServeEngine(
-        params, config,
-        sampler=Sampler(kind="greedy"),
-        max_slots=spec["slots"],
-        num_blocks=num_blocks,
-        block_size=bs,
-        max_seq_len=max_seq_len,
-        prefill_chunk=chunk,
+    num_blocks = spec.get(
+        "num_blocks", sized_blocks + spec.get("extra_blocks", 0)
     )
+    cache_dtype = spec.get("cache_dtype", "bf16")
+    # probe the SAME kernel the engine's gate will check (int8 pools use
+    # the int8 variant) so the attn_impl label can't drift from what ran
+    paged_err = kernel_error(paged_kernel_name(cache_dtype == "int8"))
+    impls = {"gather": "xla"}
+    if paged_err is None:
+        impls["paged"] = "paged"
+
     # seed 13 for both the trace rng and per-request sampler seeds:
     # `serve-bench --seed 13` with matching flags replays the SAME trace
     rng = np.random.default_rng(13)
@@ -688,39 +716,65 @@ def run_serve_config(name: str) -> dict:
                           spec["prompt_len"]),
         max_new_tokens=spec["max_tokens"], vocab_size=config.vocab_size,
         seed_base=13,
+        distinct_prompts=spec.get("distinct_prompts"),
     )
     _phase(name, "trace_built", t0)
-    # compile outside the measured span: the replay must report
-    # steady-state serving numbers, not first-compile stalls
-    engine.warmup([int(t["prompt"].size) for t in trace],
-                  max_new_tokens=spec["max_tokens"])
-    _phase(name, "warmed", t0)
-    snap = engine.replay_trace(trace)
-    _phase(name, "trace_drained", t0, ticks=snap["ticks"])
-    # record whether the block-table-native kernel compiles on this
-    # backend (the ROADMAP follow-up integrates it into the decode
-    # forward; the live-TPU round reads this verdict first)
-    from llm_np_cp_tpu.ops.pallas.support import kernel_error
 
-    paged_err = kernel_error("paged_decode_attention")
+    per_impl: dict = {}
+    for impl_name, decode_attn_impl in impls.items():
+        engine = ServeEngine(
+            params, config,
+            sampler=Sampler(kind="greedy"),
+            max_slots=spec["slots"],
+            num_blocks=num_blocks,
+            block_size=bs,
+            max_seq_len=max_seq_len,
+            prefill_chunk=chunk,
+            cache_dtype=jnp.int8 if cache_dtype == "int8" else jnp.bfloat16,
+            decode_attn_impl=decode_attn_impl,
+            enable_prefix_cache=spec.get("prefix_cache", False),
+        )
+        # compile outside the measured span: the replay must report
+        # steady-state serving numbers, not first-compile stalls
+        engine.warmup([int(t["prompt"].size) for t in trace],
+                      max_new_tokens=spec["max_tokens"])
+        _phase(name, f"warmed_{impl_name}", t0)
+        snap = engine.replay_trace(trace)
+        _phase(name, f"trace_drained_{impl_name}", t0, ticks=snap["ticks"])
+        per_impl[impl_name] = {
+            "ok": snap["finished"] == spec["requests"],
+            "throughput_tok_s": round(snap["throughput_tok_s"], 1),
+            "ttft_s_p50": round(snap.get("ttft_s_p50", float("nan")), 4),
+            "ttft_s_p99": round(snap.get("ttft_s_p99", float("nan")), 4),
+            "decode_tok_s_p50": round(snap.get("decode_tok_s_p50",
+                                               float("nan")), 1),
+            "preemptions": snap["preemptions"],
+            "occupancy_p99": round(snap.get("occupancy_p99", 0.0), 3),
+            "active_slots_mean": round(snap.get("active_slots_mean", 0.0), 2),
+            "kv_mib_tick_mean": round(
+                snap.get("kv_bytes_tick_mean", 0.0) / 2**20, 3
+            ),
+            "prefix_hit_rate": round(snap["prefix_hit_rate"], 3)
+            if "prefix_hit_rate" in snap else None,
+            "ticks": snap["ticks"],
+            "compile_counts": engine.compile_counts(),
+        }
+        del engine
+
+    headline = per_impl.get("paged", per_impl["gather"])
     return {
         "config": name,
-        "ok": snap["finished"] == spec["requests"],
+        "ok": all(r["ok"] for r in per_impl.values()),
         "requests": spec["requests"],
         "rate_rps": spec["rate"],
         "slots": spec["slots"],
         "pool_blocks": num_blocks,
         "block_size": bs,
-        "throughput_tok_s": round(snap["throughput_tok_s"], 1),
-        "ttft_s_p50": round(snap.get("ttft_s_p50", float("nan")), 4),
-        "ttft_s_p99": round(snap.get("ttft_s_p99", float("nan")), 4),
-        "decode_tok_s_p50": round(snap.get("decode_tok_s_p50",
-                                           float("nan")), 1),
-        "preemptions": snap["preemptions"],
-        "occupancy_p99": round(snap.get("occupancy_p99", 0.0), 3),
-        "active_slots_mean": round(snap.get("active_slots_mean", 0.0), 2),
-        "ticks": snap["ticks"],
-        "compile_counts": engine.compile_counts(),
+        "prefix_cache": bool(spec.get("prefix_cache", False)),
+        "distinct_prompts": spec.get("distinct_prompts"),
+        "attn_impl": "paged" if "paged" in per_impl else "gather",
+        **{k: v for k, v in headline.items() if k != "ok"},
+        "impls": per_impl,
         "paged_kernel_probe": paged_err or "ok",
     }
 

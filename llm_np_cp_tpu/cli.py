@@ -132,9 +132,29 @@ def build_serve_parser(default_model: str) -> argparse.ArgumentParser:
                    "can hold a worst-case request plus one spare block")
     p.add_argument("--cache-dtype", choices=["bf16", "f32", "int8"],
                    default="bf16")
+    p.add_argument("--attn-impl", choices=["gather", "paged", "auto"],
+                   default="gather",
+                   help="decode K/V access: 'gather' materializes the "
+                   "active batch's cache view through the block tables "
+                   "(the XLA path), 'paged' runs the block-table-native "
+                   "Pallas kernel with ZERO gather (requires the Mosaic "
+                   "compile probe to pass), 'auto' picks paged when the "
+                   "probe passes and falls back to gather")
+    p.add_argument("--prefix-cache", action=argparse.BooleanOptionalAction,
+                   default=False,
+                   help="share fully-filled prompt-prefix blocks across "
+                   "requests (refcounted; hits skip those prefill chunks). "
+                   "Cache entries are reclaimed LRU under pool pressure, "
+                   "so give --num-blocks headroom beyond the worst-case "
+                   "default for entries to survive between twin prompts")
     p.add_argument("--decode-attn", choices=["xla", "pallas"], default="xla",
-                   help="decode attention for the packed step (pallas is "
-                   "gated: it silently downgrades off-TPU)")
+                   help="attention kernel for the GATHERED decode step "
+                   "(pallas is gated: it silently downgrades off-TPU); "
+                   "ignored under --attn-impl paged")
+    p.add_argument("--distinct-prompts", type=int, default=0, metavar="N",
+                   help="draw only N distinct prompts and cycle requests "
+                   "through them (0 = every prompt distinct) — the "
+                   "shared-prefix workload shape --prefix-cache hits on")
     p.add_argument("--sampler", choices=["greedy", "min_p", "top_k", "top_p",
                                          "cdf"], default="greedy")
     p.add_argument("--seed", type=int, default=0)
@@ -161,10 +181,42 @@ def _run_serve_bench(argv: list[str], default_model: str) -> str:
         raise SystemExit(
             f"--block-size must be a multiple of 8, got {args.block_size}"
         )
+    if args.distinct_prompts < 0:
+        raise SystemExit(
+            f"--distinct-prompts must be >= 0 (0 = every prompt distinct), "
+            f"got {args.distinct_prompts}"
+        )
     _tok, params, config = _load(args)
     cache_dtype = {
         "bf16": jnp.bfloat16, "f32": jnp.float32, "int8": jnp.int8,
     }[args.cache_dtype]
+    # resolve --attn-impl before engine build: an EXPLICIT paged request
+    # must fail with an actionable message when Mosaic rejects the
+    # kernel, not a Pallas traceback at first dispatch (and not a silent
+    # downgrade — that's what auto is for)
+    gather_impl = "flash_decode" if args.decode_attn == "pallas" else "xla"
+    if args.attn_impl in ("paged", "auto"):
+        from llm_np_cp_tpu.ops.pallas.support import (
+            kernel_error,
+            paged_kernel_name,
+        )
+
+        paged_kernel = paged_kernel_name(args.cache_dtype == "int8")
+        err = kernel_error(paged_kernel)
+        if err is None:
+            decode_attn_impl = "paged"
+        elif args.attn_impl == "auto":
+            print(f"[serve-bench] --attn-impl auto: paged kernel "
+                  f"unavailable ({err}); using the gather path")
+            decode_attn_impl = gather_impl
+        else:
+            raise SystemExit(
+                f"--attn-impl paged: the {paged_kernel} kernel does not "
+                f"compile on this backend ({err}); use --attn-impl "
+                "gather, or auto to fall back automatically"
+            )
+    else:
+        decode_attn_impl = gather_impl
     from llm_np_cp_tpu.serve.engine import pool_geometry
 
     # same chunking as bench.run_serve_config, so the README's CLI line
@@ -184,8 +236,8 @@ def _run_serve_bench(argv: list[str], default_model: str) -> str:
         max_seq_len=max_seq_len,
         prefill_chunk=chunk,
         cache_dtype=cache_dtype,
-        decode_attn_impl="flash_decode" if args.decode_attn == "pallas"
-        else "xla",
+        decode_attn_impl=decode_attn_impl,
+        enable_prefix_cache=args.prefix_cache,
     )
     rng = np.random.default_rng(args.seed)
     trace = poisson_trace(
@@ -193,6 +245,7 @@ def _run_serve_bench(argv: list[str], default_model: str) -> str:
         prompt_len_range=(max(args.prompt_len // 4, 1), args.prompt_len),
         max_new_tokens=args.max_tokens, vocab_size=config.vocab_size,
         seed_base=args.seed,
+        distinct_prompts=args.distinct_prompts or None,
     )
     # compile outside the measured span (steady-state numbers only)
     engine.warmup([int(t["prompt"].size) for t in trace],
@@ -201,7 +254,9 @@ def _run_serve_bench(argv: list[str], default_model: str) -> str:
     out = (
         f"[serve-bench] {args.requests} requests @ {args.rate} req/s, "
         f"slots={args.slots}, pool={num_blocks}x{args.block_size} "
-        f"({args.cache_dtype})\n" + engine.metrics.format()
+        f"({args.cache_dtype}), attn={engine.decode_attn_impl}, "
+        f"prefix_cache={'on' if args.prefix_cache else 'off'}\n"
+        + engine.metrics.format()
     )
     print(out)
     if args.json:

@@ -173,6 +173,26 @@ def compute_dtype(params: Params) -> jnp.dtype:
     return params["final_norm"].dtype
 
 
+def scan_unroll(config: ModelConfig) -> int:
+    """Layer-scan unroll factor so the compiler can software-pipeline the
+    per-layer weight stream across layer boundaries — decode is bound by
+    that stream.  config.scan_unroll is the API (part of every jit cache
+    key the config closes over); LLMTPU_SCAN_UNROLL overrides it at TRACE
+    time only — an env change after a fn's first trace does nothing for
+    that fn (the bench A/Bs via the env var in fresh subprocesses).
+    Non-divisors and malformed values degrade to 1.  The ONE definition
+    shared by ``forward`` and the serve engine's paged decode scan."""
+    try:
+        unroll = int(
+            os.environ.get("LLMTPU_SCAN_UNROLL", str(config.scan_unroll)).strip()
+        )
+    except ValueError:
+        unroll = 1  # malformed values degrade like non-divisors do
+    if unroll < 1 or config.num_hidden_layers % unroll:
+        unroll = 1
+    return unroll
+
+
 def _project(x: jnp.ndarray, w: Any) -> jnp.ndarray:
     return quant_einsum("bsh,ho->bso", x, w).astype(x.dtype)
 
@@ -220,12 +240,13 @@ def run_decoder_layer(
     act: Any,
     cos: jnp.ndarray,
     sin: jnp.ndarray,
-    mask_global: jnp.ndarray,
+    mask_global: jnp.ndarray | None = None,
     mask_local: jnp.ndarray | None = None,
     sliding: jnp.ndarray | bool = False,
     attn_impl: str = "xla",
     kv_update: Any = None,
     output_attentions: bool = False,
+    attn_fn: Any = None,
 ) -> tuple[
     jnp.ndarray,
     tuple[jnp.ndarray, jnp.ndarray],
@@ -240,17 +261,22 @@ def run_decoder_layer(
         reference's cache-less mode, llama3.2_model.py:874-880).
     sliding: traced bool — selects ``mask_local`` (and the flash kernel's
         window) for Gemma-2's alternating local layers.
+    attn_fn: optional ``(q, k_att, v_att, sliding) -> attn`` override — the
+        serving engine's paged decode path supplies the block-table-native
+        kernel here (its visibility comes from per-row scalars, not a
+        [B, Sq, Skv] mask, so ``mask_global``/``mask_local`` may be None).
 
     Returns ``(x_out, (k_att, v_att), attn_weights | None, moe_aux_loss)``
-    (aux loss is 0.0 for dense layers).  Shared by ``forward``'s lax.scan
-    and the pipeline-parallel schedule (parallel/pipeline.py), so both
-    trace identical layer math.
+    (aux loss is 0.0 for dense layers).  Shared by ``forward``'s lax.scan,
+    the pipeline-parallel schedule (parallel/pipeline.py), and the serve
+    engine's paged decode scan, so all trace identical layer math.
     """
-    mask = (
-        jnp.where(sliding, mask_local, mask_global)
-        if config.sliding_window is not None
-        else mask_global
-    )
+    if attn_fn is None:
+        mask = (
+            jnp.where(sliding, mask_local, mask_global)
+            if config.sliding_window is not None
+            else mask_global
+        )
     b, s = x.shape[:2]
     h = rms_norm(
         x, w["ln_attn_in"], eps=config.rms_norm_eps,
@@ -273,7 +299,9 @@ def run_decoder_layer(
         k_att, v_att = k, v
 
     attn_weights = None
-    if attn_impl in ("flash", "ring"):
+    if attn_fn is not None:
+        attn = attn_fn(q, k_att, v_att, sliding)
+    elif attn_impl in ("flash", "ring"):
         if attn_impl == "flash":
             from llm_np_cp_tpu.ops.pallas.flash_attention import flash_attention as _impl_fn
         else:
@@ -559,24 +587,9 @@ def forward(
             ys += (attn_weights,)
         return x, ys
 
-    # Unroll the layer scan so the compiler can software-pipeline the
-    # per-layer weight stream across layer boundaries — decode is bound
-    # by that stream.  config.scan_unroll is the API (part of every jit
-    # cache key the config closes over); LLMTPU_SCAN_UNROLL overrides it
-    # at TRACE time only — an env change after a fn's first trace does
-    # nothing for that fn (the bench A/Bs via the env var in fresh
-    # subprocesses).  Non-divisors and malformed values degrade to 1.
-    try:
-        unroll = int(
-            os.environ.get("LLMTPU_SCAN_UNROLL", str(config.scan_unroll)).strip()
-        )
-    except ValueError:
-        unroll = 1  # malformed values degrade like non-divisors do
-    if unroll < 1 or config.num_hidden_layers % unroll:
-        unroll = 1
     x, scan_out = lax.scan(
         layer_step, x, (lp, k_cache, v_cache, ks_cache, vs_cache, is_sliding),
-        unroll=unroll,
+        unroll=scan_unroll(config),
     )
     new_k, new_v = scan_out[0], scan_out[1]
     new_ks, new_vs = scan_out[2], scan_out[3]

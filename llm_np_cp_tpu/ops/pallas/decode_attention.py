@@ -197,15 +197,20 @@ def _block_bounds(mask: jnp.ndarray, block_s: int, n_blocks: int) -> jnp.ndarray
 
 
 def _paged_kernel(
-    meta_ref, tables_ref, q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref,
-    *, scale: float, softcap: float | None, kv_heads: int, group: int,
-    block_s: int,
+    meta_ref, tables_ref, *refs,
+    scale: float, softcap: float | None, quantized: bool, kv_heads: int,
+    group: int, block_s: int,
 ):
     """Block-table variant of ``_decode_kernel``: the kv grid step fetches
     the POOL block named by the row's table (scalar-prefetched), so the
     serving engine's gather→contiguous copy never materializes.  The
     visibility mask is derived in-kernel from the row's (pad, length)
     scalars instead of a streamed [B, S] mask operand."""
+    if quantized:
+        (q_ref, k_ref, v_ref, ks_ref, vs_ref,
+         o_ref, m_ref, l_ref, acc_ref) = refs
+    else:
+        q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref = refs
     bi = pl.program_id(0)
     j = pl.program_id(1)
     nj = pl.num_programs(1)
@@ -228,6 +233,13 @@ def _paged_kernel(
         mask = (pos >= pad) & (pos < length)  # [1, block_s]
         kb = k_ref[0]  # [block_s, K, D]
         vb = v_ref[0]
+        dtype = q_ref.dtype
+        if quantized:
+            # int8 pool blocks: HBM streams 1-byte values + f32 scale
+            # pages; dequant is one VMEM multiply per block (same
+            # contract as _decode_kernel's int8 mode)
+            kb = kb.astype(dtype) * ks_ref[0][..., None].astype(dtype)
+            vb = vb.astype(dtype) * vs_ref[0][..., None].astype(dtype)
         s = jnp.concatenate(
             [
                 jax.lax.dot_general(
@@ -280,6 +292,8 @@ def paged_decode_attention(
     lengths: jnp.ndarray,
     pads: jnp.ndarray,
     *,
+    k_scale: jnp.ndarray | None = None,
+    v_scale: jnp.ndarray | None = None,
     scale: float,
     logit_softcap: float | None = None,
     interpret: bool | None = None,
@@ -300,21 +314,32 @@ def paged_decode_attention(
     pool block found through the scalar-prefetched table, and blocks
     outside [pads//BS, ceil(lengths/BS)) are skipped entirely.
 
-    This is the serving-engine decode kernel for the live-TPU round
-    (kernel-gated; float pools — the int8 pool currently decodes through
-    the XLA gather path).  interpret=None auto-selects like
-    decode_attention.
+    int8 pool mode: pass k_pages/v_pages as int8 with ``k_scale``/
+    ``v_scale`` [NB, BS, K] f32 scale pages (the block_pool quantized
+    layout); the kernel streams 1-byte blocks and dequantizes in VMEM.
+
+    This is the serving-engine decode kernel (``attn_impl="paged"`` in
+    ServeEngine, kernel-gated via ops/pallas/support.py).
+    interpret=None auto-selects like decode_attention.
     """
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
+    quantized = k_scale is not None
+    if (
+        quantized != (k_pages.dtype == jnp.int8)
+        or quantized != (v_pages.dtype == jnp.int8)
+        or quantized != (v_scale is not None)
+    ):
+        raise ValueError(
+            "int8 k_pages AND v_pages require both k_scale and v_scale "
+            f"pages (and vice versa); got k={k_pages.dtype}, "
+            f"v={v_pages.dtype}, "
+            f"k_scale={'set' if k_scale is not None else None}, "
+            f"v_scale={'set' if v_scale is not None else None}"
+        )
     b, one, h, d = q.shape
     assert one == 1, f"paged_decode_attention is q_len=1 only, got {one}"
     nb_pool, block_s, kh, _ = k_pages.shape
-    if k_pages.dtype == jnp.int8:
-        raise NotImplementedError(
-            "int8 pools decode through the XLA gather path; the paged "
-            "kernel streams float blocks only"
-        )
     g = h // kh
     mb = tables.shape[1]
 
@@ -327,26 +352,37 @@ def paged_decode_attention(
         jj = jnp.minimum(meta_ref[0, bi] + j, meta_ref[1, bi] - 1)
         return (tables_ref[bi, jj], 0, 0, 0)
 
+    def _scale_map(bi, j, meta_ref, tables_ref):
+        jj = jnp.minimum(meta_ref[0, bi] + j, meta_ref[1, bi] - 1)
+        return (tables_ref[bi, jj], 0, 0)
+
+    kv_spec = pl.BlockSpec((1, block_s, kh, d), _kv_map,
+                           memory_space=pltpu.VMEM)
+    in_specs = [
+        pl.BlockSpec(
+            (1, kh, g, d),
+            lambda bi, j, meta_ref, tables_ref: (bi, 0, 0, 0),
+            memory_space=pltpu.VMEM,
+        ),
+        kv_spec,
+        kv_spec,
+    ]
+    operands = [qf, k_pages, v_pages]
+    if quantized:
+        scale_spec = pl.BlockSpec((1, block_s, kh), _scale_map,
+                                  memory_space=pltpu.VMEM)
+        in_specs += [scale_spec, scale_spec]
+        operands += [k_scale, v_scale]
     out = pl.pallas_call(
         functools.partial(
             _paged_kernel, scale=scale, softcap=logit_softcap,
-            kv_heads=kh, group=g, block_s=block_s,
+            quantized=quantized, kv_heads=kh, group=g, block_s=block_s,
         ),
         out_shape=jax.ShapeDtypeStruct((b, kh, g, d), q.dtype),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=2,
             grid=(b, mb),
-            in_specs=[
-                pl.BlockSpec(
-                    (1, kh, g, d),
-                    lambda bi, j, meta_ref, tables_ref: (bi, 0, 0, 0),
-                    memory_space=pltpu.VMEM,
-                ),
-                pl.BlockSpec((1, block_s, kh, d), _kv_map,
-                             memory_space=pltpu.VMEM),
-                pl.BlockSpec((1, block_s, kh, d), _kv_map,
-                             memory_space=pltpu.VMEM),
-            ],
+            in_specs=in_specs,
             out_specs=pl.BlockSpec(
                 (1, kh, g, d),
                 lambda bi, j, meta_ref, tables_ref: (bi, 0, 0, 0),
@@ -359,7 +395,7 @@ def paged_decode_attention(
             ],
         ),
         interpret=interpret,
-    )(meta, tables, qf, k_pages, v_pages)
+    )(meta, tables, *operands)
 
     return out.reshape(b, 1, h, d)
 

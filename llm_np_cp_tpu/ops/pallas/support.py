@@ -77,7 +77,7 @@ def _probe(kernel: str, backend: str) -> str | None:
                 np.asarray(decode_attention(
                     q, kv, kv, mask, scale=0.125, block_s=64, interpret=False,
                 ))
-        elif kernel == "paged_decode_attention":
+        elif kernel in ("paged_decode_attention", "paged_decode_attention_int8"):
             from llm_np_cp_tpu.ops.pallas.decode_attention import (
                 paged_decode_attention,
             )
@@ -95,15 +95,31 @@ def _probe(kernel: str, backend: str) -> str | None:
             tables = jnp.asarray([[2, 1], [3, 0]], jnp.int32)
             lengths = jnp.asarray([40, 63], jnp.int32)
             pads = jnp.asarray([0, 35], jnp.int32)
+            kwargs = {}
+            if kernel.endswith("int8"):
+                from llm_np_cp_tpu.cache import quantize_kv
+
+                pages, scales = quantize_kv(pages)
+                kwargs = dict(k_scale=scales, v_scale=scales)
             np.asarray(paged_decode_attention(
                 q, pages, pages, tables, lengths, pads, scale=0.125,
-                interpret=False,
+                interpret=False, **kwargs,
             ))
         else:
             raise ValueError(f"unknown kernel {kernel!r}")
     except Exception as e:  # noqa: BLE001 — any compile/runtime error gates
         return f"{type(e).__name__}: {e}"
     return None
+
+
+def paged_kernel_name(int8_cache: bool) -> str:
+    """Probe/kernel name for the block-table-native decode kernel — THE
+    one int8-gating rule, shared by ``gate_attn_impl`` and the CLI's
+    pre-build check so the two can't drift."""
+    return (
+        "paged_decode_attention_int8" if int8_cache
+        else "paged_decode_attention"
+    )
 
 
 def kernel_error(kernel: str) -> str | None:
@@ -127,6 +143,7 @@ def gate_attn_impl(impl: str, *, int8_cache: bool = False) -> str:
         "flash_decode": (
             "decode_attention_int8" if int8_cache else "decode_attention"
         ),
+        "paged": paged_kernel_name(int8_cache),
         "xla": None,
     }.get(impl)
     if kernel is None:

@@ -15,10 +15,16 @@ Modules:
   decode slots as others finish, evict-on-OOM with requeue; pure
   Python/NumPy, so policies are testable without a model.
 - ``engine``      — ``ServeEngine``: jit-stable prefill/decode steps
-  over the packed active batch (K/V gathered through block tables) with
-  per-request streaming callbacks.
+  over the packed active batch with per-request streaming callbacks;
+  decode K/V access is gathered through block tables (``"xla"``/
+  ``"flash_decode"``) or zero-gather via the block-table-native Pallas
+  kernel (``"paged"``).
+- ``prefix_cache`` — refcounted prompt-prefix block sharing: chained
+  content hashes → pool block ids, claimed at admission so matching
+  prefill chunks are skipped entirely.
 - ``metrics``     — queue depth, TTFT, per-request decode tok/s, pool
-  occupancy, preemptions; exported as a dict.
+  occupancy, preemptions, prefix hit-rate, K/V bytes per tick; exported
+  as a dict.
 """
 
 from llm_np_cp_tpu.serve.block_pool import BlockPool, FreeList
@@ -28,12 +34,14 @@ from llm_np_cp_tpu.serve.engine import (
     worst_case_slots,
 )
 from llm_np_cp_tpu.serve.metrics import ServeMetrics
+from llm_np_cp_tpu.serve.prefix_cache import PrefixCache, prefix_block_keys
 from llm_np_cp_tpu.serve.scheduler import Request, RequestState, Scheduler
 from llm_np_cp_tpu.serve.trace import poisson_trace
 
 __all__ = [
     "BlockPool",
     "FreeList",
+    "PrefixCache",
     "Request",
     "RequestState",
     "Scheduler",
@@ -41,5 +49,6 @@ __all__ = [
     "ServeMetrics",
     "poisson_trace",
     "pool_geometry",
+    "prefix_block_keys",
     "worst_case_slots",
 ]

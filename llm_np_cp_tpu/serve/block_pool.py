@@ -23,9 +23,12 @@ absmax scales (cache.quantize_kv layout) ride in parallel
 ``[L, NB, BS, K]`` f32 pages.
 
 The allocator is host-side Python (a free list) — allocation happens at
-scheduling time, between device steps, never under jit.  The device-side
-pages are a pytree (``PagedKV``) threaded through the engine's jitted
-steps and donated, so slabs update in place.
+scheduling time, between device steps, never under jit.  Blocks are
+REFCOUNTED so prompt-prefix blocks can be shared across requests
+(serve/prefix_cache.py): ``free`` is a decref and only a block's last
+holder returns it to the free list.  The device-side pages are a pytree
+(``PagedKV``) threaded through the engine's jitted steps and donated, so
+slabs update in place.
 """
 
 from __future__ import annotations
@@ -38,12 +41,17 @@ from llm_np_cp_tpu.config import ModelConfig
 
 
 class FreeList:
-    """LIFO free-list allocator over block ids ``1..num_blocks-1``.
+    """LIFO free-list allocator over block ids ``1..num_blocks-1``, with
+    per-block refcounts for prefix sharing.
 
     Block 0 is the reserved scratch block (see module docstring).  LIFO
     reuse keeps recently-freed blocks hot (their slab pages are most
-    likely still in cache on real hardware).  Pure Python so scheduler
-    policies are testable without any device arrays.
+    likely still in cache on real hardware).  ``alloc`` hands out blocks
+    at refcount 1; ``incref`` adds a sharer; ``free`` is a DECREF — a
+    block returns to the free list only when its last reference drops,
+    so a shared prefix block survives any one request's finish or
+    eviction.  Pure Python so scheduler policies are testable without
+    any device arrays.
     """
 
     def __init__(self, num_blocks: int) -> None:
@@ -53,7 +61,7 @@ class FreeList:
             )
         self.num_blocks = num_blocks
         self._free: list[int] = list(range(num_blocks - 1, 0, -1))
-        self._allocated: set[int] = set()
+        self._ref: dict[int, int] = {}  # allocated block id → refcount
 
     @property
     def num_free(self) -> int:
@@ -61,29 +69,49 @@ class FreeList:
 
     @property
     def num_allocated(self) -> int:
-        return len(self._allocated)
+        return len(self._ref)
 
     @property
     def capacity(self) -> int:
         """Allocatable blocks (excludes the reserved scratch block)."""
         return self.num_blocks - 1
 
+    def refcount(self, block_id: int) -> int:
+        """Current references on ``block_id`` (0 if free/unknown)."""
+        return self._ref.get(block_id, 0)
+
     def alloc(self, n: int) -> list[int] | None:
-        """Pop ``n`` blocks, or None (and no change) if not enough free."""
+        """Pop ``n`` blocks at refcount 1, or None (and no change) if
+        not enough free."""
         if n < 0:
             raise ValueError(f"alloc({n})")
         if n > len(self._free):
             return None
         ids = [self._free.pop() for _ in range(n)]
-        self._allocated.update(ids)
+        for i in ids:
+            self._ref[i] = 1
         return ids
 
-    def free(self, ids: list[int]) -> None:
+    def incref(self, ids: list[int]) -> None:
+        """Add one reference per block (a new sharer of a prefix block).
+        Only allocated blocks can gain references."""
         for i in ids:
-            if i not in self._allocated:
+            if i not in self._ref:
+                raise ValueError(f"incref on unallocated block id {i}")
+        for i in ids:
+            self._ref[i] += 1
+
+    def free(self, ids: list[int]) -> None:
+        """Drop one reference per block; blocks whose count hits zero
+        return to the free list.  Releasing a block with no references
+        is still a hard error (double free)."""
+        for i in ids:
+            if i not in self._ref:
                 raise ValueError(f"double free or foreign block id {i}")
-            self._allocated.discard(i)
-            self._free.append(i)
+            self._ref[i] -= 1
+            if self._ref[i] == 0:
+                del self._ref[i]
+                self._free.append(i)
 
 
 class PagedKV(NamedTuple):
@@ -121,6 +149,7 @@ class BlockPool:
         num_blocks: int,
         block_size: int,
         dtype: jnp.dtype = jnp.bfloat16,
+        enable_prefix_cache: bool = False,
     ) -> None:
         if block_size < 8 or block_size % 8:
             # Mosaic's second-minor alignment rule for the decode kernels;
@@ -130,6 +159,12 @@ class BlockPool:
         self.block_size = block_size
         self.dtype = jnp.dtype(dtype)
         self.free_list = FreeList(num_blocks)
+        if enable_prefix_cache:
+            from llm_np_cp_tpu.serve.prefix_cache import PrefixCache
+
+            self.prefix_cache: PrefixCache | None = PrefixCache(self.free_list)
+        else:
+            self.prefix_cache = None
         shape = (
             config.num_hidden_layers,
             num_blocks,
@@ -152,7 +187,14 @@ class BlockPool:
 
     @property
     def num_free(self) -> int:
-        return self.free_list.num_free
+        """Blocks available for allocation: the free list plus prefix-
+        cache entries whose only reference is the cache's own (reclaimed
+        on demand by ``alloc``) — shared blocks never double-count
+        against pool capacity."""
+        n = self.free_list.num_free
+        if self.prefix_cache is not None:
+            n += self.prefix_cache.n_reclaimable
+        return n
 
     @property
     def capacity(self) -> int:
@@ -160,14 +202,23 @@ class BlockPool:
 
     @property
     def occupancy(self) -> float:
-        """Fraction of allocatable blocks currently held by requests."""
-        return self.free_list.num_allocated / max(self.free_list.capacity, 1)
+        """Fraction of allocatable blocks currently held by requests —
+        the complement of ``num_free``, so cache-only (reclaimable)
+        prefix blocks count as free here too, keeping the two admission
+        metrics mutually consistent."""
+        return (self.capacity - self.num_free) / max(self.capacity, 1)
 
     def blocks_for(self, n_tokens: int) -> int:
         """Blocks needed to hold ``n_tokens`` cache slots."""
         return -(-n_tokens // self.block_size)
 
     def alloc(self, n: int) -> list[int] | None:
+        if (
+            self.prefix_cache is not None
+            and n > self.free_list.num_free
+        ):
+            # evict LRU cache-only entries to cover the shortfall
+            self.prefix_cache.release(n - self.free_list.num_free)
         return self.free_list.alloc(n)
 
     def free(self, ids: list[int]) -> None:

@@ -25,19 +25,30 @@ Inactive slots point their tables at the reserved scratch block 0 and
 carry length 0, so the decode step runs branchless at full width; their
 outputs are discarded host-side.
 
-The XLA gather materializes the active batch's K/V view each step — the
-stated first implementation.  ``decode_attn_impl="flash_decode"`` routes
-the gathered attention through the existing Pallas decode kernel (gated,
-ops/pallas/support.py).  The block-table-NATIVE kernel that skips the
-gather entirely, ops/pallas/decode_attention.paged_decode_attention, is
-NOT wired into this forward yet — it has parity tests and a compile
-probe (support.py), and bench.run_serve_config records the probe verdict
-so the live-TPU round can validate it before the ROADMAP follow-up
-integrates it here.
+Decode attention impls (``decode_attn_impl``, gated by the hardware
+compile probes in ops/pallas/support.py with XLA as the fallback):
+
+- ``"xla"`` — the materialized-gather path above.
+- ``"flash_decode"`` — same gather, attention through the mask-driven
+  Pallas decode kernel.
+- ``"paged"`` — ZERO-GATHER: the per-layer scan threads the pool slabs
+  themselves and ops/pallas/decode_attention.paged_decode_attention
+  reads K/V straight through the scalar-prefetched block tables, so the
+  [L, B, S_max] view never materializes and per-token HBM traffic scales
+  with each row's visible blocks instead of the padded table width
+  (asserted structurally via jaxpr inspection in tests).  int8 pools
+  stream quantized blocks + scale pages through the kernel.
+
+Prefix sharing (``enable_prefix_cache``): at admission the prompt's
+fully-filled leading blocks are looked up in a refcounted registry
+(serve/prefix_cache.py); hits are claimed into the request's block table
+and their prefill chunks are SKIPPED — only the shared K/V is copied
+into the temp prefill cache so the remaining chunks attend correctly.
 """
 
 from __future__ import annotations
 
+import math
 import time
 from functools import partial
 from typing import Any, Callable
@@ -47,13 +58,22 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
-from llm_np_cp_tpu.cache import KVCache
+from llm_np_cp_tpu.cache import KVCache, quantize_kv
 from llm_np_cp_tpu.config import ModelConfig
 from llm_np_cp_tpu.generate import IncrementalDetok, make_ragged_prefill_step
-from llm_np_cp_tpu.models.transformer import forward
+from llm_np_cp_tpu.models.transformer import (
+    embed_inputs,
+    final_logits,
+    forward,
+    run_decoder_layer,
+    scan_unroll,
+)
+from llm_np_cp_tpu.ops.activations import ACT2FN
+from llm_np_cp_tpu.ops.rope import rope_cos_sin
 from llm_np_cp_tpu.ops.sampling import Sampler
 from llm_np_cp_tpu.serve.block_pool import BlockPool, PagedKV
 from llm_np_cp_tpu.serve.metrics import ServeMetrics
+from llm_np_cp_tpu.serve.prefix_cache import prefix_block_keys
 from llm_np_cp_tpu.serve.scheduler import Request, Scheduler
 
 Params = dict[str, Any]
@@ -125,19 +145,21 @@ class ServeEngine:
         prefill_chunk: int | None = None,
         cache_dtype: jnp.dtype = jnp.bfloat16,
         decode_attn_impl: str = "xla",
+        enable_prefix_cache: bool = False,
         tokenizer: Any = None,
         clock: Callable[[], float] = time.perf_counter,
     ) -> None:
-        if decode_attn_impl not in ("xla", "flash_decode"):
+        if decode_attn_impl not in ("xla", "flash_decode", "paged"):
             raise ValueError(
-                f"decode_attn_impl must be 'xla' or 'flash_decode', "
-                f"got {decode_attn_impl!r}"
+                f"decode_attn_impl must be 'xla', 'flash_decode' or "
+                f"'paged', got {decode_attn_impl!r}"
             )
         from llm_np_cp_tpu.ops.pallas.support import gate_attn_impl
 
         decode_attn_impl = gate_attn_impl(
             decode_attn_impl, int8_cache=jnp.dtype(cache_dtype) == jnp.int8
         )
+        self.decode_attn_impl = decode_attn_impl  # post-gate (tests/CLI)
         self.params = params
         self.config = config
         self.sampler = sampler or Sampler(kind="greedy")
@@ -151,8 +173,18 @@ class ServeEngine:
         # gather width S_max = max_blocks_per_seq * block_size)
         self.max_seq_len = _ceil_to(max_seq_len, block_size)
         self.max_blocks_per_seq = self.max_seq_len // block_size
+        # prefix-share granularity in BLOCKS: shared prefixes must cover
+        # whole blocks (pool granularity) AND whole prefill chunks (so
+        # skipped prefill work is exactly the shared region — a partial
+        # chunk would re-prefill and re-WRITE a shared block)
+        self._share_unit = (
+            math.lcm(self.block_size, self.prefill_chunk) // self.block_size
+        )
 
-        self.pool = BlockPool(config, num_blocks, block_size, dtype=cache_dtype)
+        self.pool = BlockPool(
+            config, num_blocks, block_size, dtype=cache_dtype,
+            enable_prefix_cache=enable_prefix_cache,
+        )
         self.scheduler = Scheduler(
             self.pool,
             max_slots=max_slots,
@@ -160,6 +192,7 @@ class ServeEngine:
             blocks_for_prefill=lambda req: self.pool.blocks_for(
                 self._prefill_width(req)
             ),
+            prefill_plan=self._prefill_plan,
         )
         self.metrics = ServeMetrics(clock=clock)
         self._next_id = 0
@@ -170,6 +203,7 @@ class ServeEngine:
         self._decode_step = self._make_decode_step(decode_attn_impl)
         self._sample_first = self._make_sample_first()
         self._scatter_prefill = self._make_scatter_prefill()
+        self._gather_prefix = self._make_gather_prefix()
 
     # ------------------------------------------------------------------
     def _prefill_width(self, req: Request) -> int:
@@ -177,6 +211,42 @@ class ServeEngine:
         a whole number of chunks (ONE compiled chunk program for every
         prompt length)."""
         return _ceil_to(req.total_len, self.prefill_chunk)
+
+    def _prefill_plan(self, req: Request) -> tuple[list[int], int]:
+        """Admission plan: ``(claimed shared block ids, fresh blocks
+        needed)``.  With the prefix cache on, the prompt's fully-filled
+        leading blocks are hashed and the longest registered chain is
+        CLAIMED (one reference per block); the fresh need excludes them,
+        so shared blocks don't double-count against pool capacity.  The
+        shareable span is capped at ``width - prefill_chunk``: the LAST
+        chunk always re-prefills because the first token's logits come
+        out of it, and the cap also guarantees decode writes land
+        strictly past every shared block."""
+        w = self._prefill_width(req)
+        total = self.pool.blocks_for(w)
+        cache = self.pool.prefix_cache
+        if cache is None:
+            return [], total
+        unit = self._share_unit
+        n_keys = ((w - self.prefill_chunk) // (unit * self.block_size)) * unit
+        if n_keys <= 0:
+            return [], total
+        # a request stuck at the queue head is re-planned EVERY tick —
+        # reuse the hashes while its content (hence width) is unchanged
+        # instead of re-running SHA-256 over the prompt each attempt
+        keys = req.extra.get("prefix_keys")
+        if keys is None or req.extra.get("prefix_keys_width") != w:
+            content = req.effective_prompt()
+            keys = prefix_block_keys(
+                content, w - content.size, self.block_size, n_keys
+            )
+            req.extra["prefix_keys"] = keys
+            req.extra["prefix_keys_width"] = w
+        # only whole prefill chunks can be skipped — truncate the match
+        # to share-unit multiples before claiming
+        n_shared = (len(cache.match(keys)) // unit) * unit
+        shared = cache.claim(keys[:n_shared]) if n_shared else []
+        return shared, total - len(shared)
 
     def compile_counts(self) -> dict[str, int]:
         """Compiled-program count per jitted step (the static-shape
@@ -193,6 +263,7 @@ class ServeEngine:
             "decode_step": size(self._decode_step),
             "sample_first": size(self._sample_first),
             "scatter_prefill": size(self._scatter_prefill),
+            "gather_prefix": size(self._gather_prefix),
         }
 
     # ------------------------------------------------------------------
@@ -213,16 +284,22 @@ class ServeEngine:
         bs = self.block_size
 
         @partial(jax.jit, donate_argnums=(0,))
-        def scatter_prefill(pages: PagedKV, cache: KVCache, ids: jnp.ndarray):
+        def scatter_prefill(
+            pages: PagedKV, cache: KVCache, ids: jnp.ndarray,
+            start: jnp.ndarray,
+        ):
             # cache: batch-1 contiguous prefill cache at the FIXED temp
-            # capacity (max_seq_len); only the first nb*bs slots hold
-            # this request's content
+            # capacity (max_seq_len); the nb*bs slots from block offset
+            # ``start`` (traced — prefix hits shift it without a
+            # retrace) hold this request's freshly prefilled content.
+            # Shared prefix blocks before ``start`` are NEVER written.
             nb = ids.shape[0]
 
             def put(slab, page, trailing):  # slab [L, 1, max_seq_len, *t]
                 l = slab.shape[0]
+                fresh = lax.dynamic_slice_in_dim(slab, start * bs, nb * bs, 1)
                 return page.at[:, ids].set(
-                    slab[:, : nb * bs].reshape((l, nb, bs) + trailing)
+                    fresh.reshape((l, nb, bs) + trailing)
                 )
 
             kh, d = cache.k.shape[-2:]
@@ -242,7 +319,55 @@ class ServeEngine:
 
         return scatter_prefill
 
+    def _make_gather_prefix(self) -> Callable:
+        """(temp cache, pages, shared ids [H], pad) → temp cache with the
+        shared blocks' K/V copied into slots [0, H*bs) and validity/
+        length restored — the state a full prefill of those chunks would
+        have left, so the remaining chunks attend correctly.  One small
+        copy program per distinct shared-block count (same compile class
+        as the scatter), instead of re-running the model over the shared
+        chunks."""
+        quantized = self.cache_dtype == jnp.int8
+        bs = self.block_size
+        cap = self.max_seq_len
+
+        @partial(jax.jit, donate_argnums=(0,))
+        def gather_prefix(
+            cache: KVCache, pages: PagedKV, ids: jnp.ndarray,
+            pad: jnp.ndarray,
+        ):
+            h = ids.shape[0]
+            l = pages.k.shape[0]
+
+            def get(page, trailing):  # [L, NB, bs, *t] → [L, 1, h*bs, *t]
+                return page[:, ids].reshape((l, 1, h * bs) + trailing)
+
+            def put(slab, page, trailing):
+                return slab.at[:, :, : h * bs].set(get(page, trailing))
+
+            kh, d = pages.k.shape[-2:]
+            pos = jnp.arange(cap, dtype=jnp.int32)[None, :]
+            valid = (pos >= pad) & (pos < h * bs)
+            return KVCache(
+                k=put(cache.k, pages.k, (kh, d)),
+                v=put(cache.v, pages.v, (kh, d)),
+                valid=valid,
+                length=jnp.full((), h * bs, jnp.int32),
+                k_scale=(
+                    put(cache.k_scale, pages.k_scale, (kh,))
+                    if quantized else None
+                ),
+                v_scale=(
+                    put(cache.v_scale, pages.v_scale, (kh,))
+                    if quantized else None
+                ),
+            )
+
+        return gather_prefix
+
     def _make_decode_step(self, attn_impl: str) -> Callable:
+        if attn_impl == "paged":
+            return self._make_paged_decode_step()
         config, sampler = self.config, self.sampler
         bs = self.block_size
         quantized = self.cache_dtype == jnp.int8
@@ -315,6 +440,131 @@ class ServeEngine:
                     pages.v_scale.at[:, blk, off].set(col(cache.v_scale))
                     if quantized else None
                 ),
+            )
+            return nxt, new_pages
+
+        return decode_step
+
+    def _make_paged_decode_step(self) -> Callable:
+        """The zero-gather decode step: the layer scan threads the pool
+        slabs themselves ([L, NB, BS, K, D] xs), each layer scatters the
+        new token's K/V column straight into its slab and attends with
+        ``paged_decode_attention`` through the scalar-prefetched block
+        tables — no [L, B, S_max] view ever materializes (pinned by a
+        jaxpr-inspection test).  Shapes are identical to the gather
+        step's host contract, so the tick loop is impl-agnostic."""
+        from llm_np_cp_tpu.ops.pallas.decode_attention import (
+            paged_decode_attention,
+        )
+
+        config, sampler = self.config, self.sampler
+        bs = self.block_size
+        quantized = self.cache_dtype == jnp.int8
+        win = config.sliding_window
+        num_layers = config.num_hidden_layers
+
+        @partial(jax.jit, donate_argnums=(1,))
+        def decode_step(
+            params: Params,
+            pages: PagedKV,
+            tables: jnp.ndarray,   # [B, MB] int32 (scratch-0 padded)
+            lengths: jnp.ndarray,  # [B] int32 — cache slots already written
+            pads: jnp.ndarray,     # [B] int32 — left pads per row
+            toks: jnp.ndarray,     # [B] int32 — current input token
+            seeds: jnp.ndarray,    # [B] uint32 — per-request RNG seed
+        ):
+            # this tick writes slot ``lengths`` per row; attention then
+            # sees slots [pads, lengths+1) — causality is positional
+            # (the query IS the newest token), so no mask tensor exists
+            blk = jnp.take_along_axis(
+                tables, (lengths // bs)[:, None], axis=1
+            )[:, 0]
+            off = lengths % bs
+            vis = lengths + 1
+            content_pos = lengths - pads
+
+            x = embed_inputs(params, toks[:, None], config)
+            cos, sin = rope_cos_sin(
+                content_pos[:, None], config, dtype=jnp.float32
+            )
+            act = ACT2FN[config.hidden_act]
+            is_sliding = jnp.array(
+                [config.layer_is_sliding(i) for i in range(num_layers)],
+                dtype=jnp.bool_,
+            )
+
+            def layer_step(x: jnp.ndarray, xs: tuple) -> tuple:
+                if quantized:
+                    w, kp, vp, ksp, vsp, sliding = xs
+                else:
+                    w, kp, vp, sliding = xs
+
+                def kv_update(k, v):  # fresh projections [B, 1, K, D]
+                    # inactive rows all write (scratch block 0, slot 0);
+                    # duplicate scatter indices there are harmless —
+                    # garbage by construction, never visible
+                    if quantized:
+                        kq, ks = quantize_kv(k)
+                        vq, vs = quantize_kv(v)
+                        return (
+                            (kp.at[blk, off].set(kq[:, 0]),
+                             ksp.at[blk, off].set(ks[:, 0])),
+                            (vp.at[blk, off].set(vq[:, 0]),
+                             vsp.at[blk, off].set(vs[:, 0])),
+                        )
+                    return (
+                        kp.at[blk, off].set(k[:, 0]),
+                        vp.at[blk, off].set(v[:, 0]),
+                    )
+
+                def attn_fn(q, k_att, v_att, sliding_l):
+                    if quantized:
+                        (kp2, ksp2), (vp2, vsp2) = k_att, v_att
+                    else:
+                        kp2, vp2 = k_att, v_att
+                        ksp2 = vsp2 = None
+                    row_pads = pads
+                    if win is not None:
+                        # the single query sits at slot ``vis - 1``; a
+                        # sliding layer sees slots > vis-1-win, i.e. an
+                        # effective left pad of vis - win
+                        row_pads = jnp.where(
+                            sliding_l, jnp.maximum(pads, vis - win), pads
+                        )
+                    return paged_decode_attention(
+                        q, kp2, vp2, tables, vis, row_pads,
+                        k_scale=ksp2, v_scale=vsp2,
+                        scale=config.attn_scale,
+                        logit_softcap=config.attn_logit_softcapping,
+                    )
+
+                x, kv_att, _, _ = run_decoder_layer(
+                    w, x, config=config, act=act, cos=cos, sin=sin,
+                    sliding=sliding, kv_update=kv_update, attn_fn=attn_fn,
+                )
+                if quantized:
+                    (kp2, ksp2), (vp2, vsp2) = kv_att
+                    return x, (kp2, vp2, ksp2, vsp2)
+                return x, kv_att
+
+            xs: tuple = (params["layers"], pages.k, pages.v)
+            if quantized:
+                xs += (pages.k_scale, pages.v_scale)
+            xs += (is_sliding,)
+            x, ys = lax.scan(layer_step, x, xs, unroll=scan_unroll(config))
+            new_pages = PagedKV(
+                k=ys[0], v=ys[1],
+                k_scale=ys[2] if quantized else None,
+                v_scale=ys[3] if quantized else None,
+            )
+            logits = final_logits(params, x, config, last_only=True)
+            # same (seed, content position) key derivation as the gather
+            # step — the RNG stream is impl- and preemption-invariant
+            keys = jax.vmap(
+                lambda s, t: jax.random.fold_in(jax.random.PRNGKey(s), t)
+            )(seeds, content_pos)
+            nxt = jax.vmap(lambda k, lg: sampler(k, lg[None])[0])(
+                keys, logits[:, -1]
             )
             return nxt, new_pages
 
@@ -407,14 +657,24 @@ class ServeEngine:
     # ------------------------------------------------------------------
     def _prefill_request(self, req: Request) -> None:
         """Chunked ragged prefill into a temp contiguous cache, scatter
-        into the request's blocks, sample + emit the first token."""
+        into the request's blocks, sample + emit the first token.
+
+        Prefix-cache hits (``req.n_shared_blocks`` leading blocks claimed
+        at admission) SKIP their prefill chunks entirely: the shared K/V
+        is copied from the pool into the temp cache (bit-identical to
+        what those chunks would have computed — a slot's K/V depends only
+        on its token and position) and the remaining chunks run from that
+        offset.  Only the fresh blocks are scattered back; shared blocks
+        are never written."""
         content = req.effective_prompt()
         w = self._prefill_width(req)
         req.pad = w - content.size
+        n_shared = req.n_shared_blocks
+        shared_slots = n_shared * self.block_size
         # FIXED temp capacity: a per-bucket cap would retrace the whole
         # model prefill once per prompt-length bucket (a multi-second
-        # mid-traffic stall on TPU); only the cheap scatter is allowed
-        # to specialize per block count
+        # mid-traffic stall on TPU); only the cheap scatter/gather is
+        # allowed to specialize per block count
         cap = self.max_seq_len
         ids = np.zeros((1, w), dtype=np.int32)
         mask = np.zeros((1, w), dtype=bool)
@@ -424,16 +684,32 @@ class ServeEngine:
         ids_d, mask_d = jnp.asarray(ids), jnp.asarray(mask)
 
         cache = KVCache.init(self.config, 1, cap, dtype=self.cache_dtype)
+        if n_shared:
+            cache = self._gather_prefix(
+                cache, self.pool.pages,
+                jnp.asarray(np.asarray(req.block_ids[:n_shared], np.int32)),
+                jnp.int32(req.pad),
+            )
         last = None
-        for off in range(0, w, self.prefill_chunk):
+        for off in range(shared_slots, w, self.prefill_chunk):
             end = off + self.prefill_chunk
             last, cache = self._prefill_step(
                 self.params, ids_d[:, off:end], cache, mask_d[:, off:end], pads
             )
         self.pool.pages = self._scatter_prefill(
             self.pool.pages, cache,
-            jnp.asarray(np.asarray(req.block_ids, dtype=np.int32)),
+            jnp.asarray(np.asarray(req.block_ids[n_shared:], dtype=np.int32)),
+            jnp.int32(n_shared),
         )
+        pc = self.pool.prefix_cache
+        keys = req.extra.pop("prefix_keys", None)
+        req.extra.pop("prefix_keys_width", None)
+        if pc is not None and keys:
+            # register this prefill's fully-filled prompt blocks so the
+            # NEXT matching prompt hits (claimed blocks are already
+            # registered — register only LRU-touches them)
+            pc.register(keys, req.block_ids[: len(keys)])
+            self.metrics.on_prefix(requested=len(keys), hits=n_shared)
         tok = self._sample_first(
             last,
             jnp.uint32(req.seed),
@@ -486,8 +762,41 @@ class ServeEngine:
             occupancy=self.pool.occupancy,
             active_slots=len(running) if running else 0,
             preemptions_total=self.scheduler.n_preemptions,
+            kv_bytes=self._kv_bytes_tick(running) if running else 0,
         )
         return self.scheduler.has_work
+
+    def _kv_bytes_tick(self, running: list[Request]) -> int:
+        """K/V bytes this tick's decode attention touches — the
+        observable for the gather→paged win.  The gather impls
+        materialize the full padded [L, B, S_max] view regardless of
+        content; the paged kernel streams only each row's visible blocks
+        (first-pad block through the length block — and on sliding-
+        window layers only the window's blocks, counted per layer)."""
+        cfg = self.config
+        item = self.cache_dtype.itemsize
+        per_slot = cfg.num_key_value_heads * cfg.head_dim * item * 2  # K+V
+        if self.cache_dtype == jnp.int8:
+            per_slot += cfg.num_key_value_heads * 4 * 2  # f32 scale pages
+        n_layers = cfg.num_hidden_layers
+        if self.decode_attn_impl != "paged":
+            return self.scheduler.max_slots * self.max_seq_len \
+                * n_layers * per_slot
+        bs = self.block_size
+        win = cfg.sliding_window
+        n_sliding = (
+            sum(cfg.layer_is_sliding(i) for i in range(n_layers))
+            if win is not None else 0
+        )
+        slot_layers = 0  # sum over rows of (slots streamed × layers)
+        for r in running:
+            nb_hi = -(-r.cache_len // bs)
+            full = (nb_hi - r.pad // bs) * bs
+            slot_layers += (n_layers - n_sliding) * full
+            if n_sliding:
+                pad_eff = max(r.pad, r.cache_len - win)
+                slot_layers += n_sliding * (nb_hi - pad_eff // bs) * bs
+        return slot_layers * per_slot
 
     def warmup(
         self, prompt_lens: list[int], max_new_tokens: int = 2,
@@ -498,11 +807,11 @@ class ServeEngine:
         multi-second and would dominate TTFT p99).
 
         prefill/decode/sample each compile once, so one dummy request
-        covers them.  The scatter specializes per prefill block count,
-        and a preemption re-prefill can produce ANY count up to the
-        workload's worst case — warm them all by scattering a zero temp
-        cache into the scratch block (garbage there is harmless by
-        construction)."""
+        covers them.  The scatter and prefix-gather specialize per block
+        count, and a preemption re-prefill can produce ANY count up to
+        the workload's worst case — warm them all by scattering/gathering
+        a zero temp cache against the scratch block (garbage there is
+        harmless by construction)."""
         if not prompt_lens:
             return
         # two decode tokens compile the decode/sample/column-scatter
@@ -521,8 +830,28 @@ class ServeEngine:
         )
         for nb in range(1, b_max + 1):
             self.pool.pages = self._scatter_prefill(
-                self.pool.pages, cache, jnp.zeros((nb,), jnp.int32)
+                self.pool.pages, cache, jnp.zeros((nb,), jnp.int32),
+                jnp.int32(0),
             )
+        if self.pool.prefix_cache is not None:
+            # a prefix hit can cover any share-unit multiple of blocks up
+            # to one chunk short of the worst width — warm each gather
+            # shape, then drop the dummy request's registered blocks so
+            # the measured span starts with a cold cache
+            unit = self._share_unit
+            h_max = (
+                (b_max * self.block_size - self.prefill_chunk)
+                // (unit * self.block_size)
+            ) * unit
+            for h in range(unit, max(h_max, 0) + 1, unit):
+                cache = KVCache.init(
+                    self.config, 1, self.max_seq_len, dtype=self.cache_dtype
+                )
+                self._gather_prefix(
+                    cache, self.pool.pages, jnp.zeros((h,), jnp.int32),
+                    jnp.int32(0),
+                )
+            self.pool.prefix_cache.clear()
         self.metrics = ServeMetrics(clock=self.clock)
 
     def run_until_complete(self, max_ticks: int = 100_000) -> None:

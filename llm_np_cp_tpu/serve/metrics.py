@@ -13,6 +13,13 @@ same numbers:
 - ``active_slots_*``       — decode slots busy (batch efficiency)
 - ``preemptions``          — evict-on-OOM count (requeues)
 - ``throughput_tok_s``     — total generated tokens / wall span
+- ``prefix_hit_rate``      — prompt blocks reused from the prefix cache
+                             / shareable prompt blocks requested
+- ``kv_bytes_tick_*``      — K/V bytes the decode attention touches per
+                             tick (the gather→paged observable: the XLA
+                             gather path streams the full padded view,
+                             the paged kernel only each row's visible
+                             blocks)
 
 Percentiles are p50/p90/p99 over whatever was recorded — no windowing;
 a serving front-end would wire these into a real metrics sink
@@ -56,6 +63,9 @@ class ServeMetrics:
         self.queue_depth: list[int] = []
         self.occupancy: list[float] = []
         self.active_slots: list[int] = []
+        self.kv_bytes_tick: list[float] = []
+        self.prefix_blocks_requested = 0
+        self.prefix_blocks_hit = 0
 
     # -- record hooks (engine calls these) -----------------------------
     def on_submit(self, req: Request) -> None:
@@ -67,7 +77,7 @@ class ServeMetrics:
 
     def on_tick(
         self, *, queue_depth: int, occupancy: float, active_slots: int,
-        preemptions_total: int,
+        preemptions_total: int, kv_bytes: int = 0,
     ) -> None:
         self.n_ticks += 1
         self.t_last = self.clock()
@@ -75,6 +85,16 @@ class ServeMetrics:
         self.occupancy.append(occupancy)
         self.active_slots.append(active_slots)
         self.preemptions = preemptions_total
+        if active_slots:
+            # only decode ticks stream cache; idle/admission-only ticks
+            # would dilute the per-tick gauge with zeros
+            self.kv_bytes_tick.append(float(kv_bytes))
+
+    def on_prefix(self, *, requested: int, hits: int) -> None:
+        """One prefill's prefix-cache outcome: ``requested`` shareable
+        prompt blocks were looked up, ``hits`` were reused."""
+        self.prefix_blocks_requested += requested
+        self.prefix_blocks_hit += hits
 
     def on_token(self, req: Request) -> None:
         self.total_generated += 1
@@ -110,6 +130,14 @@ class ServeMetrics:
         out.update(_pcts([float(q) for q in self.queue_depth], "queue_depth"))
         out.update(_pcts(self.occupancy, "occupancy"))
         out.update(_pcts([float(a) for a in self.active_slots], "active_slots"))
+        out.update(_pcts(self.kv_bytes_tick, "kv_bytes_tick"))
+        out["kv_bytes_total"] = float(sum(self.kv_bytes_tick))
+        out["prefix_blocks_requested"] = self.prefix_blocks_requested
+        out["prefix_blocks_hit"] = self.prefix_blocks_hit
+        if self.prefix_blocks_requested:
+            out["prefix_hit_rate"] = (
+                self.prefix_blocks_hit / self.prefix_blocks_requested
+            )
         return out
 
     def format(self) -> str:
@@ -119,6 +147,15 @@ class ServeMetrics:
         def g(key: str, fmt: str = "{:.3f}") -> str:
             return fmt.format(s[key]) if key in s else "-"
 
+        mb_tick = (
+            f"{s['kv_bytes_tick_mean'] / 2**20:.2f}"
+            if "kv_bytes_tick_mean" in s else "-"
+        )
+        prefix = (
+            f"{s['prefix_hit_rate']:.2f} "
+            f"({s['prefix_blocks_hit']}/{s['prefix_blocks_requested']} blocks)"
+            if "prefix_hit_rate" in s else "-"
+        )
         return (
             f"requests: {s['submitted']} submitted, {s['finished']} finished, "
             f"{s['preemptions']} preemptions over {s['ticks']} ticks\n"
@@ -132,5 +169,6 @@ class ServeMetrics:
             f"p99 {g('queue_depth_p99', '{:.1f}')}; "
             f"occupancy p50 {g('occupancy_p50', '{:.2f}')}  "
             f"p99 {g('occupancy_p99', '{:.2f}')}; "
-            f"active_slots mean {g('active_slots_mean', '{:.2f}')}"
+            f"active_slots mean {g('active_slots_mean', '{:.2f}')}\n"
+            f"kv MiB/tick mean {mb_tick}; prefix cache hit rate {prefix}"
         )

@@ -15,9 +15,10 @@ Policies (deliberately boring — the interesting state is in the pool):
 - **Growth**: before each decode tick every running request whose next
   token would overflow its allocated blocks gets one more block.
 - **Eviction**: if that allocation fails, the *youngest* running request
-  (most recent admission) is preempted: its blocks return to the pool
-  and it is requeued at the FRONT of the queue with its generated tokens
-  kept.  On readmission it re-prefills prompt+generated (teacher-forced)
+  (most recent admission) is preempted: its block references drop (a
+  block returns to the pool only when its LAST sharer lets go — prefix
+  blocks shared with other requests survive) and it is requeued at the
+  FRONT of the queue with its generated tokens kept.  On readmission it re-prefills prompt+generated (teacher-forced)
   and continues — with a deterministic sampler this reproduces the
   uninterrupted output exactly (pinned in tests).  Preempting youngest +
   requeue-at-front preserves FIFO completion order, so no request
@@ -61,6 +62,9 @@ class Request:
     state: RequestState = RequestState.QUEUED
     generated: list[int] = dataclasses.field(default_factory=list)
     block_ids: list[int] = dataclasses.field(default_factory=list)
+    # leading entries of block_ids claimed from the prefix cache (their
+    # K/V is already in the pool; the engine skips those prefill chunks)
+    n_shared_blocks: int = 0
     pad: int = 0  # left-pad slots in this request's cache region
     slot: int = -1  # decode slot while RUNNING
     n_preemptions: int = 0
@@ -114,6 +118,7 @@ class Scheduler:
         max_slots: int,
         block_size: int,
         blocks_for_prefill: Callable[[Request], int] | None = None,
+        prefill_plan: Callable[[Request], tuple[list[int], int]] | None = None,
         decode_reserve: int = 1,
     ) -> None:
         if max_slots < 1:
@@ -124,6 +129,14 @@ class Scheduler:
         self.decode_reserve = decode_reserve
         self._blocks_for_prefill = blocks_for_prefill or (
             lambda req: -(-req.total_len // block_size)
+        )
+        # prefill_plan(req) → (shared_block_ids, fresh_need): shared ids
+        # arrive ALREADY claimed (one reference each, prefix-cache hit);
+        # admission either completes with them at the head of
+        # req.block_ids or releases them before backing off.  Default:
+        # no sharing, everything fresh.
+        self._prefill_plan = prefill_plan or (
+            lambda req: ([], self._blocks_for_prefill(req))
         )
         self.queue: deque[Request] = deque()
         self.running: list[Request] = []  # admission order (oldest first)
@@ -155,14 +168,19 @@ class Scheduler:
         admitted: list[Request] = []
         while self.queue and self._free_slots:
             req = self.queue[0]
-            need = self._blocks_for_prefill(req)
+            shared, need = self._prefill_plan(req)
             if self.allocator.num_free < need + self.decode_reserve:
+                if shared:  # release the claim before backing off
+                    self.allocator.free(shared)
                 break  # strict FIFO: never skip the head
             ids = self.allocator.alloc(need)
             if ids is None:
+                if shared:
+                    self.allocator.free(shared)
                 break
             self.queue.popleft()
-            req.block_ids = ids
+            req.block_ids = shared + ids
+            req.n_shared_blocks = len(shared)
             req.slot = self._free_slots.pop()
             req.state = RequestState.RUNNING
             self.running.append(req)
@@ -208,6 +226,7 @@ class Scheduler:
     def _preempt(self, req: Request) -> None:
         self.allocator.free(req.block_ids)
         req.block_ids = []
+        req.n_shared_blocks = 0
         req.pad = 0
         self._release_slot(req)
         self.running.remove(req)

@@ -29,6 +29,7 @@ def poisson_trace(
     max_new_tokens: int | tuple[int, int],
     vocab_size: int,
     seed_base: int = 0,
+    distinct_prompts: int | None = None,
 ) -> list[dict[str, Any]]:
     """``n_requests`` arrivals for ``ServeEngine.replay_trace``.
 
@@ -36,6 +37,10 @@ def poisson_trace(
     prompt_len_range / max_new_tokens: inclusive ranges sampled uniformly
     (an int ``max_new_tokens`` pins every request to that budget, which
     the engine-vs-offline parity tests need).
+    distinct_prompts: if set, only this many distinct prompts are
+    generated and requests cycle through them — the shared-prefix
+    workload shape (many users asking the same things) that the
+    refcounted prefix cache is built for.
     """
     if n_requests < 1:
         raise ValueError(f"n_requests must be >= 1, got {n_requests}")
@@ -44,19 +49,45 @@ def poisson_trace(
     lo, hi = prompt_len_range
     if not (1 <= lo <= hi):
         raise ValueError(f"bad prompt_len_range {prompt_len_range}")
+    if distinct_prompts is not None and distinct_prompts < 1:
+        raise ValueError(f"distinct_prompts must be >= 1, got {distinct_prompts}")
     arrivals = np.cumsum(rng.exponential(1.0 / rate_rps, size=n_requests))
-    trace: list[dict[str, Any]] = []
-    for i in range(n_requests):
-        plen = int(rng.integers(lo, hi + 1))
+
+    def draw_mnt() -> int:
         if isinstance(max_new_tokens, tuple):
             mlo, mhi = max_new_tokens
-            mnt = int(rng.integers(mlo, mhi + 1))
+            return int(rng.integers(mlo, mhi + 1))
+        return int(max_new_tokens)
+
+    def make_prompt() -> np.ndarray:
+        plen = int(rng.integers(lo, hi + 1))
+        return (
+            rng.integers(1, vocab_size, size=plen, dtype=np.int64)
+            .astype(np.int32)
+        )
+
+    pool = (
+        [make_prompt() for _ in range(distinct_prompts)]
+        if distinct_prompts is not None else None
+    )
+    trace: list[dict[str, Any]] = []
+    for i in range(n_requests):
+        if pool is not None:
+            prompt = pool[i % len(pool)]
+            mnt = draw_mnt()
         else:
-            mnt = int(max_new_tokens)
+            # draw order (plen, mnt, tokens) is the historical sequence —
+            # a fixed seed must keep replaying the exact same trace
+            # across versions
+            plen = int(rng.integers(lo, hi + 1))
+            mnt = draw_mnt()
+            prompt = (
+                rng.integers(1, vocab_size, size=plen, dtype=np.int64)
+                .astype(np.int32)
+            )
         trace.append({
             "arrival_s": float(arrivals[i]),
-            "prompt": rng.integers(1, vocab_size, size=plen, dtype=np.int64)
-            .astype(np.int32),
+            "prompt": prompt,
             "max_new_tokens": mnt,
             "seed": seed_base + i,
         })

@@ -428,3 +428,47 @@ def test_cli_serve_bench_json_flag(fake_load, capsys):
 def test_cli_serve_bench_rejects_bad_block_size(fake_load):
     with pytest.raises(SystemExit, match="multiple of 8"):
         cli.run(["serve-bench", "--block-size=12"])
+
+
+def test_cli_serve_bench_paged_and_prefix_cache(fake_load, capsys):
+    """--attn-impl paged + --prefix-cache + --distinct-prompts runs
+    end-to-end (CPU interpret mode), reports the flags in the banner,
+    and the repeated prompts produce a REAL nonzero hit rate (a static
+    banner string alone would pass even with sharing broken)."""
+    import re
+
+    out = cli.run([
+        "serve-bench", "--requests=8", "--rate=50", "--prompt-len=40",
+        "--max-tokens=3", "--slots=2", "--block-size=8", "--seed=1",
+        "--num-blocks=64", "--distinct-prompts=2",
+        "--attn-impl=paged", "--prefix-cache",
+    ])
+    assert "attn=paged" in out and "prefix_cache=on" in out
+    m = re.search(r"prefix cache hit rate (\d\.\d+)", out)
+    assert m, out
+    assert float(m.group(1)) > 0, out
+
+
+def test_cli_serve_bench_rejects_paged_when_probe_fails(fake_load, monkeypatch):
+    """An EXPLICIT --attn-impl paged must die with an actionable message
+    when Mosaic rejects the kernel — not a Pallas traceback; auto falls
+    back to the gather path instead."""
+    import llm_np_cp_tpu.ops.pallas.support as support
+
+    monkeypatch.setattr(support, "_FORCE_FAIL", True)
+    support._probe.cache_clear()
+    try:
+        with pytest.raises(SystemExit, match="--attn-impl"):
+            cli.run([
+                "serve-bench", "--requests=2", "--rate=50", "--prompt-len=8",
+                "--max-tokens=2", "--slots=2", "--block-size=8",
+                "--attn-impl=paged",
+            ])
+        out = cli.run([
+            "serve-bench", "--requests=2", "--rate=50", "--prompt-len=8",
+            "--max-tokens=2", "--slots=2", "--block-size=8",
+            "--attn-impl=auto",
+        ])
+        assert "attn=xla" in out
+    finally:
+        support._probe.cache_clear()

@@ -258,18 +258,54 @@ def test_paged_softcap_parity():
     np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5)
 
 
-def test_paged_rejects_int8_pool():
-    """int8 pools decode through the XLA gather path for now; the kernel
-    must refuse rather than misread quantized blocks as floats."""
+def test_paged_int8_pool_matches_dequantized_gather():
+    """int8 pool blocks + scale pages through the paged kernel must match
+    the gathered-dequantized oracle bit-for-bit in f32 (the serve
+    engine's int8 pool decodes through this path under
+    attn_impl='paged')."""
+    from llm_np_cp_tpu.cache import dequantize_kv, quantize_kv
+    from llm_np_cp_tpu.ops.pallas.decode_attention import paged_decode_attention
+
+    rng = np.random.default_rng(21)
+    b, h, kh, d, nbp, bs = 3, 8, 2, 16, 8, 16
+    q = _rand(rng, (b, 1, h, d))
+    kq, ks = quantize_kv(_rand(rng, (nbp, bs, kh, d)))
+    vq, vs = quantize_kv(_rand(rng, (nbp, bs, kh, d)))
+    tables = jnp.asarray([[1, 2, 3, 0], [4, 5, 0, 0], [7, 6, 5, 4]], jnp.int32)
+    lengths = jnp.asarray([40, 17, 64], jnp.int32)
+    pads = jnp.asarray([3, 0, 10], jnp.int32)
+    want = _paged_reference(
+        q, dequantize_kv(kq, ks, jnp.float32),
+        dequantize_kv(vq, vs, jnp.float32),
+        tables, lengths, pads, scale=d**-0.5,
+    )
+    got = paged_decode_attention(
+        q, kq, vq, tables, lengths, pads, k_scale=ks, v_scale=vs,
+        scale=d**-0.5,
+    )
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5)
+
+
+def test_paged_int8_requires_both_scales():
+    """int8 pages without scale pages (or scales with float pages) must
+    refuse rather than misread quantized blocks as floats."""
     from llm_np_cp_tpu.ops.pallas.decode_attention import paged_decode_attention
 
     q = jnp.zeros((1, 1, 4, 8))
     pages = jnp.zeros((2, 8, 2, 8), jnp.int8)
-    with pytest.raises(NotImplementedError, match="int8"):
+    scales = jnp.zeros((2, 8, 2), jnp.float32)
+    args = (jnp.zeros((1, 1), jnp.int32), jnp.asarray([4], jnp.int32),
+            jnp.asarray([0], jnp.int32))
+    with pytest.raises(ValueError, match="k_scale"):
+        paged_decode_attention(q, pages, pages, *args, scale=0.35)
+    with pytest.raises(ValueError, match="k_scale"):
         paged_decode_attention(
-            q, pages, pages, jnp.zeros((1, 1), jnp.int32),
-            jnp.asarray([4], jnp.int32), jnp.asarray([0], jnp.int32),
-            scale=0.35,
+            q, pages, pages, *args, k_scale=scales, scale=0.35
+        )
+    with pytest.raises(ValueError, match="k_scale"):
+        paged_decode_attention(
+            q, pages.astype(jnp.float32), pages.astype(jnp.float32), *args,
+            k_scale=scales, v_scale=scales, scale=0.35,
         )
 
 

@@ -193,6 +193,265 @@ def test_submit_rejects_unadmittable_request(tiny):
     engine.submit(np.arange(1, 11, dtype=np.int32), 2)
 
 
+# ---------------------------------------------------------------------------
+# attn_impl="paged": the zero-gather decode path.  Same acceptance bar as
+# the gather path — offline parity, one decode compile — plus a structural
+# assertion that the [L, B, S_max] gathered view never exists in the traced
+# program.
+# ---------------------------------------------------------------------------
+
+def _iter_eqns(jaxpr):
+    """Every eqn in ``jaxpr`` and all nested sub-jaxprs (pjit/scan/...)."""
+    for eqn in jaxpr.eqns:
+        yield eqn
+        for v in eqn.params.values():
+            yield from _iter_param_eqns(v)
+
+
+def _iter_param_eqns(v):
+    if isinstance(v, jax.core.ClosedJaxpr):
+        yield from _iter_eqns(v.jaxpr)
+    elif isinstance(v, jax.core.Jaxpr):
+        yield from _iter_eqns(v)
+    elif isinstance(v, (tuple, list)):
+        for x in v:
+            yield from _iter_param_eqns(x)
+
+
+def _decode_step_shapes(engine: ServeEngine) -> set[tuple[int, ...]]:
+    """Output shapes of every eqn in the traced decode step."""
+    b = engine.scheduler.max_slots
+    mb = engine.max_blocks_per_seq
+    args = (
+        engine.params, engine.pool.pages,
+        jnp.zeros((b, mb), jnp.int32), jnp.zeros((b,), jnp.int32),
+        jnp.zeros((b,), jnp.int32), jnp.zeros((b,), jnp.int32),
+        jnp.zeros((b,), jnp.uint32),
+    )
+    jaxpr = jax.make_jaxpr(lambda *a: engine._decode_step(*a))(*args)
+    return {
+        tuple(eqn_var.aval.shape)
+        for eqn in _iter_eqns(jaxpr.jaxpr)
+        for eqn_var in eqn.outvars
+        if hasattr(eqn_var.aval, "shape")
+    }
+
+
+def test_paged_trace_parity_32_requests_and_bounded_compiles(tiny):
+    """The gather-path acceptance criterion, re-run under
+    attn_impl='paged' (CPU interpret mode runs the same kernel logic the
+    TPU compiles): 32-request trace == offline generate_ragged, decode
+    compiles ONCE."""
+    cfg, params = tiny
+    engine = ServeEngine(
+        params, cfg, sampler=Sampler(kind="greedy"),
+        max_slots=4, num_blocks=48, block_size=8, max_seq_len=64,
+        cache_dtype=jnp.float32, decode_attn_impl="paged",
+    )
+    assert engine.decode_attn_impl == "paged"
+    rng = np.random.default_rng(0)
+    trace = poisson_trace(
+        rng, 32, rate_rps=40.0, prompt_len_range=(3, 14),
+        max_new_tokens=6, vocab_size=cfg.vocab_size,
+    )
+    snap = engine.replay_trace(trace)
+    assert snap["finished"] == 32
+    _assert_parity(engine, cfg, params, jnp.float32)
+    counts = engine.compile_counts()
+    assert counts["decode_step"] == 1
+    # the paged path streams less cache than the gather view per tick
+    assert 0 < snap["kv_bytes_tick_mean"]
+
+
+def test_paged_int8_pool_parity(tiny):
+    """int8 pool blocks flow through the paged kernel (quantize on the
+    in-scan write, scale pages streamed) with the same greedy tokens as
+    the gather path's dequantize-on-gather."""
+    cfg, params = tiny
+    engine = ServeEngine(
+        params, cfg, sampler=Sampler(kind="greedy"),
+        max_slots=3, num_blocks=16, block_size=8, max_seq_len=64,
+        cache_dtype=jnp.int8, decode_attn_impl="paged",
+    )
+    assert engine.decode_attn_impl == "paged"
+    rng = np.random.default_rng(11)
+    for n in (6, 11, 4):
+        engine.submit(rng.integers(1, cfg.vocab_size, size=n), 5)
+    engine.run_until_complete()
+    assert len(engine.scheduler.finished) == 3
+    _assert_parity(engine, cfg, params, jnp.int8)
+
+
+def test_paged_gemma2_sliding_window_parity():
+    """Gemma-2's alternating sliding layers reach the paged kernel as an
+    effective left pad (row_pads = max(pads, vis - window)) instead of a
+    mask tensor — tokens must match the gather path exactly, or the
+    per-layer window math is off by one."""
+    cfg = tiny_config("gemma2")
+    assert cfg.sliding_window is not None
+    params = init_params(jax.random.PRNGKey(2), cfg, dtype=jnp.float32)
+
+    def run(impl):
+        engine = ServeEngine(
+            params, cfg, sampler=Sampler(kind="greedy"),
+            max_slots=2, num_blocks=32, block_size=8, max_seq_len=64,
+            cache_dtype=jnp.float32, decode_attn_impl=impl,
+        )
+        rng = np.random.default_rng(5)
+        # long decodes so visible length crosses the window bound and
+        # several block boundaries on both layer kinds
+        for n in (9, 13):
+            engine.submit(rng.integers(1, cfg.vocab_size, size=n), 16)
+        engine.run_until_complete()
+        return {r.req_id: r.generated for r in engine.scheduler.finished}
+
+    assert run("xla") == run("paged")
+
+
+def test_paged_decode_step_has_no_materialized_gather(tiny):
+    """Structural zero-gather assertion: the gathered cache view
+    [L, B, S_max, K, D] (or its per-layer [B, S_max, K, D] slice) exists
+    in the gather step's jaxpr and in NO eqn of the paged step's."""
+    cfg, params = tiny
+
+    def build(impl):
+        return ServeEngine(
+            params, cfg, sampler=Sampler(kind="greedy"),
+            max_slots=4, num_blocks=16, block_size=8, max_seq_len=64,
+            cache_dtype=jnp.float32, decode_attn_impl=impl,
+        )
+
+    l = cfg.num_hidden_layers
+    kh, d = cfg.num_key_value_heads, cfg.head_dim
+    b, s_max = 4, 64
+    gathered = {(l, b, s_max, kh, d), (b, s_max, kh, d)}
+
+    gather_shapes = _decode_step_shapes(build("xla"))
+    assert gathered & gather_shapes, (
+        "control failed: the gather step no longer materializes the "
+        "gathered view — update this test's shape expectations"
+    )
+    paged_shapes = _decode_step_shapes(build("paged"))
+    hit = gathered & paged_shapes
+    assert not hit, (
+        f"attn_impl='paged' materialized a gathered cache view {hit} — "
+        "the zero-gather contract is broken"
+    )
+
+
+def test_engine_rejects_unknown_decode_impl(tiny):
+    cfg, params = tiny
+    with pytest.raises(ValueError, match="decode_attn_impl"):
+        ServeEngine(params, cfg, decode_attn_impl="pallas")
+
+
+def test_paged_falls_back_to_xla_when_probe_fails(tiny, monkeypatch):
+    """The hardware gate: when Mosaic rejects the paged kernel the
+    engine downgrades to the gather path with a warning instead of dying
+    at first dispatch."""
+    import llm_np_cp_tpu.ops.pallas.support as support
+
+    monkeypatch.setattr(support, "_FORCE_FAIL", True)
+    support._probe.cache_clear()
+    try:
+        cfg, params = tiny
+        engine = ServeEngine(
+            params, cfg, max_slots=2, num_blocks=16, block_size=8,
+            max_seq_len=64, cache_dtype=jnp.float32,
+            decode_attn_impl="paged",
+        )
+        assert engine.decode_attn_impl == "xla"
+    finally:
+        support._probe.cache_clear()
+
+
+# ---------------------------------------------------------------------------
+# Refcounted prefix sharing: identical prompts reuse prompt blocks; a hit
+# must skip prefill chunks without changing a single output token.
+# ---------------------------------------------------------------------------
+
+def _count_prefill_calls(engine):
+    calls = [0]
+    orig = engine._prefill_step
+
+    def counting(*a, **k):
+        calls[0] += 1
+        return orig(*a, **k)
+
+    engine._prefill_step = counting
+    return calls
+
+
+@pytest.mark.parametrize("impl", ["xla", "paged"])
+def test_prefix_sharing_parity_and_fewer_prefill_dispatches(tiny, impl):
+    """4 repeats of 2 distinct prompts: the shared run must emit the
+    exact tokens of the unshared run (and offline), dispatch strictly
+    fewer prefill chunks, and report the hit rate in the metrics."""
+    cfg, params = tiny
+    rng = np.random.default_rng(3)
+    prompts = [rng.integers(1, cfg.vocab_size, size=n) for n in (20, 17)]
+
+    def run(prefix: bool):
+        engine = ServeEngine(
+            params, cfg, sampler=Sampler(kind="greedy"),
+            max_slots=4, num_blocks=48, block_size=8, max_seq_len=64,
+            cache_dtype=jnp.float32, decode_attn_impl=impl,
+            enable_prefix_cache=prefix,
+        )
+        calls = _count_prefill_calls(engine)
+        for rep in range(4):
+            for j, p in enumerate(prompts):
+                engine.submit(p, 4, seed=j)
+        engine.run_until_complete()
+        tokens = {r.req_id: r.generated for r in engine.scheduler.finished}
+        return tokens, calls[0], engine
+
+    base_tokens, base_calls, _ = run(prefix=False)
+    shared_tokens, shared_calls, engine = run(prefix=True)
+    assert shared_tokens == base_tokens
+    assert shared_calls < base_calls, (
+        f"prefix sharing dispatched {shared_calls} prefill chunks, "
+        f"expected strictly fewer than the unshared {base_calls}"
+    )
+    snap = engine.metrics.snapshot()
+    assert snap["prefix_blocks_hit"] > 0
+    assert 0 < snap["prefix_hit_rate"] <= 1
+    _assert_parity(engine, cfg, params, jnp.float32)
+    # every request's references were released; only the cache's own
+    # remain, and they are all reclaimable
+    fl = engine.pool.free_list
+    assert fl.num_free + fl.num_allocated == fl.capacity
+    assert fl.num_allocated == len(engine.pool.prefix_cache)
+    assert engine.pool.prefix_cache.n_reclaimable == fl.num_allocated
+
+
+def test_prefix_sharing_eviction_stress_parity(tiny):
+    """Interleave evict-on-OOM with shared prefixes on a pool too small
+    for the running set: refcounted eviction must never free a block a
+    live request still references (FreeList would raise on the resulting
+    double free) and every request must still match the offline run."""
+    cfg, params = tiny
+    rng = np.random.default_rng(9)
+    prompts = [rng.integers(1, cfg.vocab_size, size=n) for n in (9, 9, 5)]
+    engine = ServeEngine(
+        params, cfg, sampler=Sampler(kind="greedy"),
+        max_slots=2, num_blocks=8, block_size=8, max_seq_len=64,
+        cache_dtype=jnp.float32, enable_prefix_cache=True,
+    )
+    for rep in range(3):
+        for j, p in enumerate(prompts):
+            engine.submit(p, 12, seed=j)
+    engine.run_until_complete()
+    assert len(engine.scheduler.finished) == 9
+    assert engine.scheduler.n_preemptions > 0, (
+        "pool was not tight enough to exercise eviction"
+    )
+    _assert_parity(engine, cfg, params, jnp.float32)
+    fl = engine.pool.free_list
+    assert fl.num_free + fl.num_allocated == fl.capacity
+    assert fl.num_allocated == len(engine.pool.prefix_cache)
+
+
 def test_metrics_snapshot_shape(tiny):
     cfg, params = tiny
     engine = ServeEngine(

@@ -58,6 +58,37 @@ def test_steady_state_ticks_compile_nothing():
     assert engine.compile_counts() == warm_counts
 
 
+def test_paged_prefix_steady_state_ticks_compile_nothing():
+    """The paged decode path with prefix sharing: after a warm pass over
+    the phase shapes (prompt-length buckets AND shared-prefix depths),
+    repeated traffic — including prefix hits and refcount churn — must
+    trigger ZERO backend compiles, and decode must have compiled exactly
+    once."""
+    cfg = tiny_config("llama")
+    params = init_params(jax.random.PRNGKey(2), cfg, dtype=jnp.float32)
+    engine = ServeEngine(
+        params, cfg, sampler=Sampler(kind="greedy"),
+        max_slots=2, num_blocks=32, block_size=8, max_seq_len=64,
+        cache_dtype=jnp.float32, decode_attn_impl="paged",
+        enable_prefix_cache=True,
+    )
+    # warm: both block-count buckets, then a repeat so the prefix-hit
+    # path (gather_prefix per shared depth) compiles too
+    _drive(engine, cfg, lens=(4, 12), seed0=0)
+    _drive(engine, cfg, lens=(4, 12), seed0=0)
+    warm_counts = dict(engine.compile_counts())
+    assert warm_counts["decode_step"] == 1
+
+    counter = CompileCounter()
+    with counter.watch():
+        _drive(engine, cfg, lens=(4, 12, 4, 12, 4), seed0=0)
+    assert counter.count == 0, (
+        f"paged+prefix steady-state serving compiled: {counter.events}"
+    )
+    assert engine.compile_counts() == warm_counts
+    assert engine.metrics.prefix_blocks_hit > 0
+
+
 def test_compile_counts_bounded_by_phase_shapes():
     """The per-program contract: decode/sample/prefill compile once (the
     temp prefill cache has a fixed capacity), scatter at most once per

@@ -60,21 +60,31 @@ class CompileCounter:
                 pass
 
 
-def assert_serve_compiles_bounded(engine, *, distinct_prefill_shapes: int) -> None:
-    """The static-shape contract for every serve/ jitted step.
+def assert_serve_compiles_bounded(
+    engine, *, distinct_prefill_shapes: int,
+    distinct_prefix_shapes: int | None = None,
+) -> None:
+    """The static-shape contract for every serve/ jitted step — for BOTH
+    decode impls: the gather step and the paged (block-table-native)
+    step share the host contract, so ``decode_step`` must stay at ONE
+    compile regardless of ``attn_impl``, prompt-length buckets, prefix
+    hits, or refcount state.
 
     distinct_prefill_shapes: how many distinct prefill block counts the
     driven workload legitimately produced (== number of distinct temp
-    cache capacities).  Anything above these bounds means a step's
-    shapes depend on per-tick state — the exact bug this lint exists to
-    catch.
+    cache capacities).  distinct_prefix_shapes: distinct shared-prefix
+    block counts (prefix-cache hits; the small gather-prefix copy is the
+    only other program allowed to specialize) — None means "don't
+    check".  Anything above these bounds means a step's shapes depend on
+    per-tick state — the exact bug this lint exists to catch.
     """
     counts = engine.compile_counts()
     problems = []
     if counts["decode_step"] > 1:
         problems.append(
-            f"decode_step compiled {counts['decode_step']}x (must be 1: "
-            "packed batch/table/pool shapes are all static)"
+            f"decode_step compiled {counts['decode_step']}x (must be 1 "
+            f"for attn_impl={engine.decode_attn_impl!r}: packed batch/"
+            "table/pool shapes are all static)"
         )
     if counts["sample_first"] > 1:
         problems.append(
@@ -91,6 +101,15 @@ def assert_serve_compiles_bounded(engine, *, distinct_prefill_shapes: int) -> No
             f"scatter_prefill compiled {counts['scatter_prefill']}x for "
             f"{distinct_prefill_shapes} distinct prefill shapes "
             "(must be <= one per phase shape, never per tick)"
+        )
+    if (
+        distinct_prefix_shapes is not None
+        and counts.get("gather_prefix", 0) > distinct_prefix_shapes
+    ):
+        problems.append(
+            f"gather_prefix compiled {counts['gather_prefix']}x for "
+            f"{distinct_prefix_shapes} distinct shared-prefix shapes "
+            "(must be <= one per shared block count, never per hit)"
         )
     if any(v < 0 for v in counts.values()):
         problems.append(
@@ -129,7 +148,32 @@ def _self_check() -> None:
     eng.run_until_complete()
     shapes = {-(-(-(-n // 8) * 8) // 8) for n in (5, 9, 5, 13)}
     assert_serve_compiles_bounded(engine=eng, distinct_prefill_shapes=len(shapes))
-    print(f"compile counts OK: {eng.compile_counts()}")
+    print(f"compile counts OK (gather): {eng.compile_counts()}")
+
+    # the paged decode path with prefix sharing: ticks across
+    # prompt-length buckets, repeated prompts (refcount churn: claim,
+    # share, release), and the prefix-gather must stay within the same
+    # bounds — decode still compiles exactly once
+    eng = ServeEngine(
+        params, cfg, sampler=Sampler(kind="greedy"), max_slots=2,
+        num_blocks=32, block_size=8, max_seq_len=64, cache_dtype=jnp.float32,
+        decode_attn_impl="paged", enable_prefix_cache=True,
+    )
+    prompts = [rng.integers(1, 200, size=n) for n in (5, 9, 13, 17)]
+    for _ in range(3):  # repeats after round 1 hit the prefix cache
+        for p in prompts:
+            eng.submit(p, 6)
+    eng.run_until_complete()
+    shapes = {-(-(-(-p.size // 8) * 8) // 8) for p in prompts}
+    prefix_shapes = {
+        r.n_shared_blocks for r in eng.scheduler.finished if r.n_shared_blocks
+    }
+    assert eng.metrics.prefix_blocks_hit > 0, "no prefix hits — bad workload"
+    assert_serve_compiles_bounded(
+        engine=eng, distinct_prefill_shapes=len(shapes) + len(prefix_shapes),
+        distinct_prefix_shapes=len(prefix_shapes),
+    )
+    print(f"compile counts OK (paged+prefix): {eng.compile_counts()}")
 
 
 if __name__ == "__main__":
