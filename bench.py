@@ -169,6 +169,21 @@ SERVE_CONFIGS = {
                         max_tokens=6, slots=2, block_size=8),
 }
 
+# HTTP front-end loadgen (llm_np_cp_tpu/serve/http/): the SAME Poisson
+# trace replayed twice on one engine build — direct ServeEngine calls
+# (realtime replay) vs in-process HTTP server + asyncio SSE clients — so
+# the HTTP layer's TTFT/throughput overhead is a measured delta, not a
+# guess.  serve_http_poisson mirrors serve_poisson_bs8's workload shape
+# so its direct leg cross-checks that config's numbers.
+SERVE_HTTP_CONFIGS = {
+    "serve_http_poisson": dict(model="llama1b", requests=32, rate=16.0,
+                               prompt_len=512, max_tokens=64, slots=8,
+                               block_size=128),
+    "smoke_serve_http": dict(model="tiny", requests=6, rate=50.0,
+                             prompt_len=16, max_tokens=4, slots=2,
+                             block_size=8),
+}
+
 SPEC_CONFIGS = {
     # batched self-speculation: bf16 target + int8 self-draft, γ=4
     "int8_spec_bs8": dict(model="llama1b", batch=8, prompt_len=128,
@@ -204,6 +219,7 @@ PRIORITY = [
     "ragged_bs8_fdec",
     "serve_poisson_bs8",  # continuous-batching serving engine (serve/)
     "serve_prefix_shared",  # prefix-cache reuse + gather-vs-paged decode
+    "serve_http_poisson",  # HTTP front-end overhead vs direct engine calls
     "gemma2_2b_bs8",      # Gemma north-star number (VERDICT task 3)
     "int8_bs8",           # roofline-gap anchor (VERDICT task 6)
     "int8a8_bs8",         # W8A8 int8-MXU einsums vs that anchor
@@ -233,6 +249,7 @@ assert set(PRIORITY) == {
     n
     for n in list(DECODE_CONFIGS) + list(SPEC_CONFIGS)
     + list(PREFILL_CONFIGS) + list(RAGGED_CONFIGS) + list(SERVE_CONFIGS)
+    + list(SERVE_HTTP_CONFIGS)
     if not n.startswith("smoke")
 } | EXTRA_CHILDREN, "PRIORITY out of sync with config dicts"
 
@@ -249,6 +266,10 @@ TIMEOUTS = {
     # (gather + paged), roughly doubling the measured span
     "serve_poisson_bs8": 850,
     "serve_prefix_shared": 850,
+    # two realtime replays of the trace (direct + HTTP) at wall-clock
+    # arrival pacing (~2s traffic span each) on top of the serve compile
+    # budget; the HTTP leg adds event-loop + SSE framing time per token
+    "serve_http_poisson": 850,
     # prefill-dominated: the marginal measurement's extra prefill+half
     # decode per rep nearly doubles measured-phase wall time
     "llama3b_seq2048_bs8": 700,
@@ -779,6 +800,142 @@ def run_serve_config(name: str) -> dict:
     }
 
 
+def run_serve_http_config(name: str) -> dict:
+    """HTTP front-end overhead: ONE engine, the SAME Poisson trace, two
+    realtime replays — direct ``ServeEngine`` calls, then the in-process
+    asyncio HTTP server driven by SSE streaming clients at the same
+    arrival times.  The delta between the two legs' TTFT/throughput is
+    the HTTP layer's cost (event loop, bridge queues, SSE framing) —
+    measured, not guessed.  The HTTP leg's TTFT is CLIENT-observed
+    (request sent → first SSE chunk parsed), which is what a user sees.
+    """
+    import asyncio
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    from llm_np_cp_tpu.ops.sampling import Sampler
+    from llm_np_cp_tpu.serve import ServeEngine, ServeMetrics, poisson_trace
+    from llm_np_cp_tpu.serve.engine import pool_geometry
+    from llm_np_cp_tpu.serve.http.client import astream_completion, http_get
+    from llm_np_cp_tpu.serve.http.server import HttpServer
+
+    t0 = time.perf_counter()
+    spec = SERVE_HTTP_CONFIGS[name]
+    config, params = _build_model(spec["model"], tag=name, t0=t0)
+    _phase(name, "params_built", t0)
+
+    bs = spec["block_size"]
+    chunk = min(bs * 2, 256)
+    _, num_blocks, max_seq_len = pool_geometry(
+        spec["prompt_len"], spec["max_tokens"], spec["slots"], bs,
+        prefill_chunk=chunk,
+    )
+    engine = ServeEngine(
+        params, config,
+        sampler=Sampler(kind="greedy"),
+        max_slots=spec["slots"],
+        num_blocks=num_blocks,
+        block_size=bs,
+        max_seq_len=max_seq_len,
+        prefill_chunk=chunk,
+        cache_dtype=jnp.bfloat16,
+    )
+    rng = np.random.default_rng(13)
+    trace = poisson_trace(
+        rng, spec["requests"], rate_rps=spec["rate"],
+        prompt_len_range=(max(spec["prompt_len"] // 4, 1),
+                          spec["prompt_len"]),
+        max_new_tokens=spec["max_tokens"], vocab_size=config.vocab_size,
+        seed_base=13,
+    )
+    engine.warmup([int(t["prompt"].size) for t in trace],
+                  max_new_tokens=spec["max_tokens"])
+    _phase(name, "warmed", t0)
+
+    # leg 1: direct engine calls at wall-clock arrival pacing — the
+    # no-HTTP baseline every client-observed number compares against
+    direct = engine.replay_trace(trace, realtime=True)
+    direct_tokens = {
+        r.req_id: list(r.generated) for r in engine.scheduler.finished
+    }
+    _phase(name, "direct_done", t0, ticks=direct["ticks"])
+
+    # leg 2: same trace through the HTTP server, one SSE client per
+    # request sleeping until its arrival time
+    engine.metrics = ServeMetrics(clock=engine.clock)
+    engine.scheduler.finished.clear()
+
+    async def http_leg() -> tuple[list[dict], str]:
+        server = HttpServer(engine, model_id=spec["model"],
+                            drain_timeout=30.0)
+        await server.start("127.0.0.1", 0)
+
+        async def one(item, idx):
+            await asyncio.sleep(item["arrival_s"])
+            return await astream_completion(
+                server.host, server.port,
+                {"model": spec["model"],
+                 "prompt": [int(t) for t in item["prompt"]],
+                 "max_tokens": item["max_new_tokens"],
+                 "seed": item.get("seed", 0)},
+                timeout=TIMEOUTS.get(name, DEFAULT_TIMEOUT) / 2,
+            )
+
+        results = await asyncio.gather(
+            *(one(item, i) for i, item in enumerate(trace))
+        )
+        loop = asyncio.get_running_loop()
+        _, prom = await loop.run_in_executor(
+            None, http_get, server.host, server.port, "/metrics")
+        server.begin_drain()
+        await server.serve_until_shutdown()
+        return list(results), prom.decode()
+
+    results, prom = asyncio.run(http_leg())
+    _phase(name, "http_done", t0)
+
+    http_ok = [r for r in results if r["status"] == 200]
+    parity = all(
+        r["token_ids"] == direct_tokens.get(rid, None)
+        for rid, r in zip(sorted(direct_tokens), http_ok)
+    ) if len(http_ok) == len(direct_tokens) else False
+    ttft_http = [r["ttft_s"] for r in http_ok if r["ttft_s"]]
+    http_snap = engine.metrics.snapshot()
+
+    def pct(vals: list, q: float) -> float:
+        # SAME estimator as ServeMetrics._pcts (np.percentile linear
+        # interpolation) — a different one here would fold estimator
+        # mismatch into the overhead delta this config exists to measure
+        return float(np.percentile(vals, q)) if vals else float("nan")
+
+    d_p50 = direct.get("ttft_s_p50", float("nan"))
+    d_p99 = direct.get("ttft_s_p99", float("nan"))
+    h_p50, h_p99 = pct(ttft_http, 50), pct(ttft_http, 99)
+    return {
+        "config": name,
+        "ok": (direct["finished"] == spec["requests"]
+               and len(http_ok) == spec["requests"] and parity),
+        "requests": spec["requests"],
+        "rate_rps": spec["rate"],
+        "slots": spec["slots"],
+        "pool_blocks": num_blocks,
+        "block_size": bs,
+        "token_parity_http_vs_direct": parity,
+        "ttft_s_p50_direct": round(d_p50, 4),
+        "ttft_s_p99_direct": round(d_p99, 4),
+        "ttft_s_p50_http": round(h_p50, 4),
+        "ttft_s_p99_http": round(h_p99, 4),
+        # the headline: what the HTTP layer costs a request's TTFT
+        "http_ttft_overhead_s_p50": round(h_p50 - d_p50, 4),
+        "http_ttft_overhead_s_p99": round(h_p99 - d_p99, 4),
+        "throughput_tok_s_direct": round(direct["throughput_tok_s"], 1),
+        "throughput_tok_s_http": round(http_snap["throughput_tok_s"], 1),
+        "metrics_scrape_ok": "llm_serve_requests_finished_total" in prom,
+        "compile_counts": engine.compile_counts(),
+    }
+
+
 def run_spec_config(name: str) -> dict:
     import numpy as np
 
@@ -877,6 +1034,7 @@ def run_warm() -> dict:
         n for n in PRIORITY
         if n not in SPEC_CONFIGS and n not in EXTRA_CHILDREN
         and n not in RAGGED_CONFIGS and n not in SERVE_CONFIGS
+        and n not in SERVE_HTTP_CONFIGS
     ]
     for name in warmable[:warm_limit]:
         spec = {**DECODE_CONFIGS, **PREFILL_CONFIGS}[name]
@@ -1215,6 +1373,8 @@ def child_main(mode: str) -> None:
         out = run_ragged_config(mode)
     elif mode in SERVE_CONFIGS:
         out = run_serve_config(mode)
+    elif mode in SERVE_HTTP_CONFIGS:
+        out = run_serve_http_config(mode)
     else:
         raise SystemExit(f"unknown config {mode!r}")
     print(json.dumps(out), flush=True)
@@ -1474,7 +1634,7 @@ def main() -> None:
         budget = min(TIMEOUTS.get(name, DEFAULT_TIMEOUT), remaining - 10)
         spec_env = {
             **DECODE_CONFIGS, **PREFILL_CONFIGS, **SPEC_CONFIGS,
-            **RAGGED_CONFIGS, **SERVE_CONFIGS,
+            **RAGGED_CONFIGS, **SERVE_CONFIGS, **SERVE_HTTP_CONFIGS,
         }.get(name, {}).get("env")
         res = _spawn(name, budget, env=spec_env)
         detail[name] = res

@@ -26,9 +26,11 @@ import numpy as np
 def build_parser(default_model: str) -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         description="TPU-native LLM inference (llm_np_cp capability surface)",
-        epilog="subcommand: serve-bench — replay a Poisson trace through "
-        "the continuous-batching ServeEngine (see serve-bench --help); "
-        "dispatched before this parser, so it accepts only its own flags",
+        epilog="subcommands (dispatched before this parser, each with its "
+        "own flags): serve-bench — replay a Poisson trace through the "
+        "continuous-batching ServeEngine (serve-bench --help); serve — "
+        "the OpenAI-compatible streaming HTTP front-end over the same "
+        "engine (serve --help)",
     )
     p.add_argument("--model", default=default_model,
                    help="HF repo id or local checkpoint dir")
@@ -107,22 +109,20 @@ def build_parser(default_model: str) -> argparse.ArgumentParser:
     return p
 
 
-def build_serve_parser(default_model: str) -> argparse.ArgumentParser:
-    p = argparse.ArgumentParser(
-        prog="serve-bench",
-        description="Replay a synthetic Poisson arrival trace through the "
-        "continuous-batching ServeEngine and report TTFT/throughput "
-        "percentiles (llm_np_cp_tpu/serve/)",
-    )
+def _add_serve_engine_flags(p: argparse.ArgumentParser,
+                            default_model: str) -> None:
+    """Engine flags shared by the ``serve-bench`` (trace replay) and
+    ``serve`` (HTTP front-end) subcommands — ONE definition so the HTTP
+    server can always be pointed at exactly the configuration a bench
+    measured."""
     p.add_argument("--model", default=default_model)
-    p.add_argument("--requests", type=int, default=16,
-                   help="number of synthetic requests in the trace")
-    p.add_argument("--rate", type=float, default=8.0, metavar="RPS",
-                   help="mean Poisson arrival rate, requests/second")
     p.add_argument("--prompt-len", type=int, default=64, metavar="MAX",
-                   help="prompt lengths are uniform in [MAX//4, MAX]")
+                   help="serve-bench: prompt lengths are uniform in "
+                   "[MAX//4, MAX]; serve: the longest prompt the pool is "
+                   "sized to admit")
     p.add_argument("--max-tokens", type=int, default=32,
-                   help="decode budget per request")
+                   help="decode budget per request (serve: the cap and "
+                   "default for the request's max_tokens field)")
     p.add_argument("--slots", type=int, default=4,
                    help="decode slots (packed batch width)")
     p.add_argument("--block-size", type=int, default=64,
@@ -151,14 +151,28 @@ def build_serve_parser(default_model: str) -> argparse.ArgumentParser:
                    help="attention kernel for the GATHERED decode step "
                    "(pallas is gated: it silently downgrades off-TPU); "
                    "ignored under --attn-impl paged")
+    p.add_argument("--sampler", choices=["greedy", "min_p", "top_k", "top_p",
+                                         "cdf"], default="greedy")
+    p.add_argument("--dtype", choices=["bf16", "f32"], default="bf16")
+
+
+def build_serve_parser(default_model: str) -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="serve-bench",
+        description="Replay a synthetic Poisson arrival trace through the "
+        "continuous-batching ServeEngine and report TTFT/throughput "
+        "percentiles (llm_np_cp_tpu/serve/)",
+    )
+    _add_serve_engine_flags(p, default_model)
+    p.add_argument("--requests", type=int, default=16,
+                   help="number of synthetic requests in the trace")
+    p.add_argument("--rate", type=float, default=8.0, metavar="RPS",
+                   help="mean Poisson arrival rate, requests/second")
     p.add_argument("--distinct-prompts", type=int, default=0, metavar="N",
                    help="draw only N distinct prompts and cycle requests "
                    "through them (0 = every prompt distinct) — the "
                    "shared-prefix workload shape --prefix-cache hits on")
-    p.add_argument("--sampler", choices=["greedy", "min_p", "top_k", "top_p",
-                                         "cdf"], default="greedy")
     p.add_argument("--seed", type=int, default=0)
-    p.add_argument("--dtype", choices=["bf16", "f32"], default="bf16")
     p.add_argument("--realtime", action="store_true",
                    help="sleep until each arrival instead of the virtual "
                    "clock (live serving simulation)")
@@ -168,32 +182,67 @@ def build_serve_parser(default_model: str) -> argparse.ArgumentParser:
     return p
 
 
-def _run_serve_bench(argv: list[str], default_model: str) -> str:
-    import json as _json
+def build_http_serve_parser(default_model: str) -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="serve",
+        description="Serve the model over HTTP: OpenAI-compatible "
+        "POST /v1/completions (SSE streaming), GET /healthz, and a "
+        "Prometheus GET /metrics (llm_np_cp_tpu/serve/http/).  Aborts "
+        "requests on client disconnect or deadline, returns 429 when the "
+        "queue cap is hit, and drains gracefully on SIGTERM",
+    )
+    _add_serve_engine_flags(p, default_model)
+    p.add_argument("--host", default="127.0.0.1",
+                   help="bind address (0.0.0.0 to accept remote clients)")
+    p.add_argument("--port", type=int, default=8000,
+                   help="TCP port; 0 picks an ephemeral port")
+    p.add_argument("--max-queue", type=int, default=64,
+                   help="queue-depth cap: submits past it get HTTP 429 "
+                   "with Retry-After (0 = unbounded)")
+    p.add_argument("--request-timeout", type=float, default=0.0,
+                   metavar="S",
+                   help="per-request deadline in seconds; past it the "
+                   "request is aborted with finish_reason='aborted' "
+                   "(0 = none; a request's own timeout_s can only lower "
+                   "it)")
+    p.add_argument("--drain-timeout", type=float, default=30.0, metavar="S",
+                   help="SIGTERM drain: wait this long for in-flight "
+                   "requests before aborting stragglers")
+    p.add_argument("--port-file", default=None, metavar="PATH",
+                   help="write 'host port' to PATH once listening "
+                   "(readiness for scripts and tests)")
+    p.add_argument("--exit-after-s", type=float, default=None,
+                   help=argparse.SUPPRESS)  # test hook: timed drain
+    return p
 
-    import jax.numpy as jnp
 
-    from llm_np_cp_tpu.ops.sampling import Sampler
-    from llm_np_cp_tpu.serve import ServeEngine, poisson_trace
-
-    args = build_serve_parser(default_model).parse_args(argv)
+def _validate_pool_flags(args) -> None:
+    """Cheap argument checks that must fire BEFORE the (potentially
+    multi-minute) model load."""
     if args.block_size < 8 or args.block_size % 8:
         raise SystemExit(
             f"--block-size must be a multiple of 8, got {args.block_size}"
         )
-    if args.distinct_prompts < 0:
-        raise SystemExit(
-            f"--distinct-prompts must be >= 0 (0 = every prompt distinct), "
-            f"got {args.distinct_prompts}"
-        )
-    _tok, params, config = _load(args)
+
+
+def _build_serve_engine(args, params, config, *, prog: str,
+                        tokenizer=None, max_queue: int | None = None):
+    """The shared engine build for both serve subcommands: validate the
+    pool flags, resolve --attn-impl against the Mosaic probe (an EXPLICIT
+    paged request must fail with an actionable message when the kernel
+    does not compile — not a Pallas traceback at first dispatch, and not
+    a silent downgrade, which is what auto is for), size the pool, build.
+    """
+    import jax.numpy as jnp
+
+    from llm_np_cp_tpu.ops.sampling import Sampler
+    from llm_np_cp_tpu.serve import ServeEngine
+    from llm_np_cp_tpu.serve.engine import pool_geometry
+
+    _validate_pool_flags(args)  # re-checked for non-CLI callers
     cache_dtype = {
         "bf16": jnp.bfloat16, "f32": jnp.float32, "int8": jnp.int8,
     }[args.cache_dtype]
-    # resolve --attn-impl before engine build: an EXPLICIT paged request
-    # must fail with an actionable message when Mosaic rejects the
-    # kernel, not a Pallas traceback at first dispatch (and not a silent
-    # downgrade — that's what auto is for)
     gather_impl = "flash_decode" if args.decode_attn == "pallas" else "xla"
     if args.attn_impl in ("paged", "auto"):
         from llm_np_cp_tpu.ops.pallas.support import (
@@ -206,7 +255,7 @@ def _run_serve_bench(argv: list[str], default_model: str) -> str:
         if err is None:
             decode_attn_impl = "paged"
         elif args.attn_impl == "auto":
-            print(f"[serve-bench] --attn-impl auto: paged kernel "
+            print(f"[{prog}] --attn-impl auto: paged kernel "
                   f"unavailable ({err}); using the gather path")
             decode_attn_impl = gather_impl
         else:
@@ -217,7 +266,6 @@ def _run_serve_bench(argv: list[str], default_model: str) -> str:
             )
     else:
         decode_attn_impl = gather_impl
-    from llm_np_cp_tpu.serve.engine import pool_geometry
 
     # same chunking as bench.run_serve_config, so the README's CLI line
     # compiles the same prefill programs as the recorded bench numbers
@@ -238,6 +286,27 @@ def _run_serve_bench(argv: list[str], default_model: str) -> str:
         cache_dtype=cache_dtype,
         decode_attn_impl=decode_attn_impl,
         enable_prefix_cache=args.prefix_cache,
+        max_queue=max_queue,
+        tokenizer=tokenizer,
+    )
+    return engine, num_blocks
+
+
+def _run_serve_bench(argv: list[str], default_model: str) -> str:
+    import json as _json
+
+    from llm_np_cp_tpu.serve import poisson_trace
+
+    args = build_serve_parser(default_model).parse_args(argv)
+    _validate_pool_flags(args)
+    if args.distinct_prompts < 0:
+        raise SystemExit(
+            f"--distinct-prompts must be >= 0 (0 = every prompt distinct), "
+            f"got {args.distinct_prompts}"
+        )
+    _tok, params, config = _load(args)
+    engine, num_blocks = _build_serve_engine(
+        args, params, config, prog="serve-bench",
     )
     rng = np.random.default_rng(args.seed)
     trace = poisson_trace(
@@ -264,11 +333,63 @@ def _run_serve_bench(argv: list[str], default_model: str) -> str:
     return out
 
 
+def _run_http_serve(argv: list[str], default_model: str) -> str:
+    from llm_np_cp_tpu.serve.http import serve_forever
+
+    args = build_http_serve_parser(default_model).parse_args(argv)
+    _validate_pool_flags(args)
+    if args.max_queue < 0:
+        raise SystemExit(f"--max-queue must be >= 0, got {args.max_queue}")
+    if args.request_timeout < 0:
+        raise SystemExit(
+            f"--request-timeout must be >= 0, got {args.request_timeout}"
+        )
+    tok, params, config = _load(args)
+    engine, num_blocks = _build_serve_engine(
+        args, params, config, prog="serve", tokenizer=tok,
+        max_queue=args.max_queue or None,
+    )
+    # warm the phase programs BEFORE accepting traffic: the first real
+    # request must not pay a multi-second model compile in its TTFT
+    engine.warmup([args.prompt_len], max_new_tokens=args.max_tokens)
+    banner = (
+        f"[serve] model={args.model} slots={args.slots} "
+        f"pool={num_blocks}x{args.block_size} ({args.cache_dtype}), "
+        f"attn={engine.decode_attn_impl}, "
+        f"prefix_cache={'on' if args.prefix_cache else 'off'}, "
+        f"max_queue={args.max_queue or 'unbounded'}"
+    )
+    print(banner)
+
+    def on_started(server) -> None:
+        print(f"[serve] listening on http://{server.host}:{server.port} "
+              f"(POST /v1/completions, GET /healthz, GET /metrics)")
+
+    serve_forever(
+        engine,
+        model_id=args.model,
+        tokenizer=tok,
+        host=args.host,
+        port=args.port,
+        request_timeout=args.request_timeout or None,
+        drain_timeout=args.drain_timeout,
+        default_max_tokens=args.max_tokens,
+        max_tokens_cap=args.max_tokens,
+        port_file=args.port_file,
+        exit_after_s=args.exit_after_s,
+        on_started=on_started,
+    )
+    print("[serve] drained, bye")
+    return banner
+
+
 def run(argv: list[str] | None = None, default_model: str = "meta-llama/Llama-3.2-1B") -> str:
     if argv is None:
         argv = sys.argv[1:]
     if argv and argv[0] == "serve-bench":
         return _run_serve_bench(argv[1:], default_model)
+    if argv and argv[0] == "serve":
+        return _run_http_serve(argv[1:], default_model)
     args = build_parser(default_model).parse_args(argv)
     _validate_draft(args)
     if args.batch_size < 0:

@@ -23,8 +23,14 @@ Modules:
   content hashes → pool block ids, claimed at admission so matching
   prefill chunks are skipped entirely.
 - ``metrics``     — queue depth, TTFT, per-request decode tok/s, pool
-  occupancy, preemptions, prefix hit-rate, K/V bytes per tick; exported
-  as a dict.
+  occupancy, preemptions, aborts/rejects, prefix hit-rate, K/V bytes per
+  tick; exported as a dict and as Prometheus text (thread-safe
+  copy-on-read snapshots — the HTTP scrape handler reads while the
+  engine thread writes).
+- ``http``        — the OpenAI-compatible streaming HTTP front-end
+  (``serve`` CLI subcommand): SSE token streams, abort on disconnect or
+  deadline, 429 backpressure off the scheduler's queue cap, Prometheus
+  ``/metrics``, SIGTERM drain.
 """
 
 from llm_np_cp_tpu.serve.block_pool import BlockPool, FreeList
@@ -35,13 +41,19 @@ from llm_np_cp_tpu.serve.engine import (
 )
 from llm_np_cp_tpu.serve.metrics import ServeMetrics
 from llm_np_cp_tpu.serve.prefix_cache import PrefixCache, prefix_block_keys
-from llm_np_cp_tpu.serve.scheduler import Request, RequestState, Scheduler
+from llm_np_cp_tpu.serve.scheduler import (
+    QueueFull,
+    Request,
+    RequestState,
+    Scheduler,
+)
 from llm_np_cp_tpu.serve.trace import poisson_trace
 
 __all__ = [
     "BlockPool",
     "FreeList",
     "PrefixCache",
+    "QueueFull",
     "Request",
     "RequestState",
     "Scheduler",

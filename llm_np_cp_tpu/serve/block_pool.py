@@ -212,6 +212,26 @@ class BlockPool:
         """Blocks needed to hold ``n_tokens`` cache slots."""
         return -(-n_tokens // self.block_size)
 
+    def stats(self) -> dict[str, int]:
+        """Point-in-time accounting for scrapes and tests: raw free-list
+        state plus the prefix-cache split (``cache_only`` blocks are held
+        solely by the cache's own reference and are reclaimable on
+        demand).  ``request_held = allocated - cache_only`` is the number
+        of blocks live requests actually pin — the quantity abort tests
+        assert returns to zero."""
+        allocated = self.free_list.num_allocated
+        cache_only = (
+            self.prefix_cache.n_reclaimable
+            if self.prefix_cache is not None else 0
+        )
+        return {
+            "capacity": self.capacity,
+            "free": self.free_list.num_free,
+            "allocated": allocated,
+            "cache_only": cache_only,
+            "request_held": allocated - cache_only,
+        }
+
     def alloc(self, n: int) -> list[int] | None:
         if (
             self.prefix_cache is not None

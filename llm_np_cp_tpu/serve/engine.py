@@ -74,7 +74,12 @@ from llm_np_cp_tpu.ops.sampling import Sampler
 from llm_np_cp_tpu.serve.block_pool import BlockPool, PagedKV
 from llm_np_cp_tpu.serve.metrics import ServeMetrics
 from llm_np_cp_tpu.serve.prefix_cache import prefix_block_keys
-from llm_np_cp_tpu.serve.scheduler import Request, Scheduler
+from llm_np_cp_tpu.serve.scheduler import (
+    QueueFull,
+    Request,
+    RequestState,
+    Scheduler,
+)
 
 Params = dict[str, Any]
 
@@ -146,6 +151,7 @@ class ServeEngine:
         cache_dtype: jnp.dtype = jnp.bfloat16,
         decode_attn_impl: str = "xla",
         enable_prefix_cache: bool = False,
+        max_queue: int | None = None,
         tokenizer: Any = None,
         clock: Callable[[], float] = time.perf_counter,
     ) -> None:
@@ -193,10 +199,14 @@ class ServeEngine:
                 self._prefill_width(req)
             ),
             prefill_plan=self._prefill_plan,
+            max_queue=max_queue,
         )
         self.metrics = ServeMetrics(clock=clock)
         self._next_id = 0
         self._detok: dict[int, IncrementalDetok] = {}
+        # live (queued or running) requests by id — the abort/deadline
+        # index; entries leave on finish and abort
+        self._requests: dict[int, Request] = {}
 
         # -- jitted programs (fixed set; tick loop never adds more) ----
         self._prefill_step = make_ragged_prefill_step(config)
@@ -581,6 +591,8 @@ class ServeEngine:
         request_id: int | None = None,
         seed: int = 0,
         callback: Callable[[Request, int, str | None], None] | None = None,
+        on_event: Callable[[Request, str], None] | None = None,
+        deadline_s: float | None = None,
         arrival_time: float | None = None,
     ) -> Request:
         prompt = np.asarray(prompt_ids, dtype=np.int32).reshape(-1)
@@ -617,17 +629,29 @@ class ServeEngine:
         if request_id is None:
             request_id = self._next_id
         self._next_id = max(self._next_id, request_id) + 1
+        if deadline_s is not None and deadline_s <= 0:
+            raise ValueError(f"deadline_s must be > 0, got {deadline_s}")
         req = Request(
             req_id=request_id,
             prompt=prompt,
             max_new_tokens=max_new_tokens,
             seed=seed,
             callback=callback,
+            on_event=on_event,
             arrival_time=arrival_time if arrival_time is not None else 0.0,
         )
         req.submit_time = self.clock()
-        self.scheduler.add(req)
+        if deadline_s is not None:
+            req.deadline = req.submit_time + deadline_s
+        try:
+            self.scheduler.add(req)
+        except QueueFull:
+            # backpressure, not a client error: count the reject so the
+            # 429s the HTTP layer returns are visible in /metrics
+            self.metrics.on_reject()
+            raise
         self.metrics.on_submit(req)
+        self._requests[req.req_id] = req
         if self.tokenizer is not None:
             self._detok[req.req_id] = IncrementalDetok(self.tokenizer)
         return req
@@ -644,15 +668,79 @@ class ServeEngine:
                 delta = detok.push(token)
             req.callback(req, int(token), delta)
 
+    def _emit_event(self, req: Request, event: str) -> None:
+        if req.on_event is not None:
+            req.on_event(req, event)
+
+    def _flush_detok(self, req: Request) -> None:
+        """Pop the request's detokenizer and park any held-back tail text
+        (mid-UTF-8 merge) in ``req.extra['final_text_delta']`` — terminal
+        events carry it so streams don't lose their last characters."""
+        detok = self._detok.pop(req.req_id, None)
+        if detok is not None:
+            tail = detok.flush()
+            if tail:
+                req.extra["final_text_delta"] = tail
+
     def _maybe_finish(self, req: Request) -> bool:
-        if req.done or (self.stop_tokens and req.generated
-                        and req.generated[-1] in self.stop_tokens):
+        if req.state is not RequestState.RUNNING:
+            # aborted out from under us (e.g. from a token callback) —
+            # already unwound, nothing left to finish
+            return True
+        hit_stop = bool(
+            self.stop_tokens and req.generated
+            and req.generated[-1] in self.stop_tokens
+        )
+        if req.done or hit_stop:
+            # a stop token on the last budgeted step still reports
+            # "stop": the model chose to end, the budget merely agreed
+            req.finish_reason = "stop" if hit_stop else "length"
             req.finish_time = self.clock()
             self.scheduler.finish(req)
+            self._requests.pop(req.req_id, None)
+            self._flush_detok(req)
             self.metrics.on_finish(req)
-            self._detok.pop(req.req_id, None)
+            self._emit_event(req, req.finish_reason)
             return True
         return False
+
+    def abort(self, request_id: int) -> bool:
+        """Cancel a live request — queued, prefilled, or mid-decode.
+
+        Its decode slot frees, its block references drop (refcounted
+        decref: prefix blocks shared with other requests survive, and
+        blocks this request registered in the prefix cache stay
+        registered under the cache's own reference), and the terminal
+        ``"aborted"`` event fires.  Returns False when the id is unknown
+        or already terminal — an abort racing a natural finish is a
+        no-op, not an error (the HTTP layer aborts on every client
+        disconnect, including disconnects after [DONE]).
+
+        NOT thread-safe, like every other engine entry point: callers
+        off the tick thread go through the HTTP runner's command queue.
+        """
+        req = self._requests.pop(request_id, None)
+        if req is None:
+            return False
+        self.scheduler.abort(req)
+        req.finish_reason = "aborted"
+        req.finish_time = self.clock()
+        self._flush_detok(req)
+        self.metrics.on_abort(req)
+        self._emit_event(req, "aborted")
+        return True
+
+    def _sweep_deadlines(self) -> None:
+        """Abort every live request past its deadline (checked once per
+        tick — a deadline can overshoot by at most one tick)."""
+        now = self.clock()
+        expired = [
+            r.req_id
+            for r in self._requests.values()
+            if r.deadline is not None and now >= r.deadline
+        ]
+        for rid in expired:
+            self.abort(rid)
 
     # ------------------------------------------------------------------
     def _prefill_request(self, req: Request) -> None:
@@ -719,14 +807,17 @@ class ServeEngine:
 
     # ------------------------------------------------------------------
     def step(self) -> bool:
-        """One scheduler tick: admissions (+prefill) then one packed
-        decode dispatch.  Returns True while work remains."""
+        """One scheduler tick: deadline sweep, admissions (+prefill),
+        then one packed decode dispatch.  Returns True while work
+        remains."""
+        self._sweep_deadlines()
         for req in self.scheduler.admit():
             self._prefill_request(req)
             self._maybe_finish(req)
 
         # preempted requests are already requeued; slots rebuilt below
-        self.scheduler.ensure_decode_blocks()
+        for req in self.scheduler.ensure_decode_blocks():
+            self._emit_event(req, "evicted-requeued")
 
         running = [
             r for r in self.scheduler.running if r.generated
@@ -852,6 +943,9 @@ class ServeEngine:
                     jnp.int32(0),
                 )
             self.pool.prefix_cache.clear()
+        # the dummy request is not part of any measured trace: drop it
+        # from the finished ledger along with the metrics it produced
+        self.scheduler.finished.clear()
         self.metrics = ServeMetrics(clock=self.clock)
 
     def run_until_complete(self, max_ticks: int = 100_000) -> None:

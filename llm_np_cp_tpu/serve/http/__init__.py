@@ -1,0 +1,34 @@
+"""OpenAI-compatible streaming HTTP front-end over ``ServeEngine``.
+
+Stdlib-only (asyncio streams — no FastAPI/uvicorn): the serving tick
+loop runs on a worker thread (``EngineRunner``), HTTP handlers on the
+event loop, bridged by per-request asyncio queues.  See ``server`` for
+the architecture, ``protocol`` for request/response shapes, ``sse`` for
+the streaming wire format, ``client`` for the stdlib loadgen/smoke
+clients.
+"""
+
+from llm_np_cp_tpu.serve.http.protocol import (
+    CompletionPayload,
+    HTTPError,
+    parse_completion_request,
+)
+from llm_np_cp_tpu.serve.http.server import (
+    EngineRunner,
+    HttpServer,
+    run_server,
+    serve_forever,
+)
+from llm_np_cp_tpu.serve.http.sse import DONE_SENTINEL, sse_event
+
+__all__ = [
+    "CompletionPayload",
+    "DONE_SENTINEL",
+    "EngineRunner",
+    "HTTPError",
+    "HttpServer",
+    "parse_completion_request",
+    "run_server",
+    "serve_forever",
+    "sse_event",
+]

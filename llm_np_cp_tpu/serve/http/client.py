@@ -1,0 +1,119 @@
+"""Stdlib clients for the HTTP front-end: bench loadgen + smoke tests.
+
+Two flavors, both dependency-free:
+
+- ``astream_completion`` — asyncio streams, one coroutine per request;
+  what the bench loadgen fans out to measure client-observed TTFT (the
+  number the HTTP layer's overhead actually shows up in).
+- ``http_get`` / ``post_completion`` — synchronous ``http.client``, the
+  "any stock client works" smoke path (no asyncio on the caller side).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import contextlib
+import http.client
+import json
+import time
+from typing import Any
+
+from llm_np_cp_tpu.serve.http.sse import iter_sse_payloads
+
+
+def http_get(host: str, port: int, path: str,
+             timeout: float = 10.0) -> tuple[int, bytes]:
+    conn = http.client.HTTPConnection(host, port, timeout=timeout)
+    try:
+        conn.request("GET", path)
+        resp = conn.getresponse()
+        return resp.status, resp.read()
+    finally:
+        conn.close()
+
+
+def post_completion(host: str, port: int, payload: dict[str, Any],
+                    timeout: float = 60.0) -> tuple[int, dict[str, Any]]:
+    """Non-streaming completion through the stock stdlib client."""
+    conn = http.client.HTTPConnection(host, port, timeout=timeout)
+    try:
+        body = json.dumps(payload)
+        conn.request("POST", "/v1/completions", body=body,
+                     headers={"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        raw = resp.read()
+        return resp.status, json.loads(raw) if raw else {}
+    finally:
+        conn.close()
+
+
+async def astream_completion(
+    host: str, port: int, payload: dict[str, Any], *,
+    timeout: float = 120.0,
+    disconnect_after: int | None = None,
+) -> dict[str, Any]:
+    """POST a streaming completion and consume its SSE stream.
+
+    Returns ``{"status", "token_ids", "text", "finish_reason",
+    "ttft_s", "latency_s", "error"}``.  ``disconnect_after=n`` closes
+    the socket after the n-th token chunk (the forced mid-stream
+    disconnect the abort tests drive); the result then carries
+    ``finish_reason="disconnected"``.
+    """
+    t0 = time.perf_counter()
+    req = dict(payload)
+    req["stream"] = True
+    body = json.dumps(req).encode()
+    reader, writer = await asyncio.open_connection(host, port)
+    out: dict[str, Any] = {
+        "status": None, "token_ids": [], "text": "",
+        "finish_reason": None, "ttft_s": None, "latency_s": None,
+        "error": None,
+    }
+    try:
+        writer.write(
+            b"POST /v1/completions HTTP/1.1\r\n"
+            + f"Host: {host}:{port}\r\n".encode()
+            + b"Content-Type: application/json\r\n"
+            + f"Content-Length: {len(body)}\r\n".encode()
+            + b"Connection: close\r\n\r\n" + body
+        )
+        await writer.drain()
+
+        async def consume() -> None:
+            status_line = await reader.readline()
+            out["status"] = int(status_line.split()[1])
+            headers = b""
+            while True:
+                line = await reader.readline()
+                if line in (b"\r\n", b"\n", b""):
+                    break
+                headers += line
+            if out["status"] != 200:
+                out["error"] = (await reader.read()).decode(errors="replace")
+                return
+            n = 0
+            text_parts: list[str] = []
+            async for chunk in iter_sse_payloads(reader):
+                choice = chunk["choices"][0]
+                if out["ttft_s"] is None:
+                    out["ttft_s"] = time.perf_counter() - t0
+                if choice.get("token_id") is not None:
+                    out["token_ids"].append(choice["token_id"])
+                if choice.get("text"):
+                    text_parts.append(choice["text"])
+                if choice.get("finish_reason"):
+                    out["finish_reason"] = choice["finish_reason"]
+                n += 1
+                if disconnect_after is not None and n >= disconnect_after:
+                    out["finish_reason"] = "disconnected"
+                    return
+            out["text"] = "".join(text_parts)
+
+        await asyncio.wait_for(consume(), timeout=timeout)
+    finally:
+        writer.close()
+        with contextlib.suppress(Exception):
+            await writer.wait_closed()
+    out["latency_s"] = time.perf_counter() - t0
+    return out

@@ -1,0 +1,635 @@
+"""Dependency-free asyncio HTTP front-end over ``ServeEngine``.
+
+Two threads, one contract:
+
+- The **engine thread** (``EngineRunner``) owns the ``ServeEngine``
+  exclusively — every engine entry point (submit/abort/step) runs there,
+  so the engine itself never needs locks.  Handlers talk to it through a
+  thread-safe command queue; admission decisions (queue-full → 429,
+  capacity ValueError → 400) are made ON the engine thread where
+  scheduler state is consistent, and the verdict comes back as the first
+  event on the request's bridge queue.
+- The **event loop** (``HttpServer``) speaks HTTP/1.1 over stdlib
+  ``asyncio`` streams (no FastAPI/uvicorn — the container has neither,
+  and a serving stack's front-end should not be the dependency
+  surface).  Per-token events cross back via
+  ``loop.call_soon_threadsafe`` onto per-request ``asyncio.Queue``s.
+
+Endpoints:
+
+- ``POST /v1/completions`` — OpenAI-compatible JSON; ``"stream": true``
+  streams SSE chunks fed from the engine's per-request callbacks.
+  Client disconnect mid-stream aborts the request (blocks decref back to
+  the pool); ``timeout_s`` (or the server-wide ``--request-timeout``)
+  becomes an engine deadline with the same abort path.
+- ``GET /healthz`` — liveness + draining state.
+- ``GET /metrics`` — Prometheus text format from ``ServeMetrics`` plus
+  live pool/stream gauges.
+
+Shutdown (SIGTERM/SIGINT): stop admission (503 on new completions),
+finish in-flight streams up to ``drain_timeout``, abort stragglers, and
+only then close the listening socket.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import contextlib
+import itertools
+import json
+import queue as queue_mod
+import signal
+import threading
+import time
+from typing import Any
+
+from llm_np_cp_tpu.serve.http.protocol import (
+    HTTPError,
+    chunk_payload,
+    completion_payload,
+    error_body,
+    parse_completion_request,
+)
+from llm_np_cp_tpu.serve.http.sse import DONE_SENTINEL, sse_event
+from llm_np_cp_tpu.serve.scheduler import QueueFull
+
+TERMINAL_EVENTS = ("stop", "length", "aborted")
+_REASONS = {
+    200: "OK", 400: "Bad Request", 404: "Not Found",
+    405: "Method Not Allowed", 408: "Request Timeout",
+    413: "Payload Too Large", 429: "Too Many Requests",
+    500: "Internal Server Error", 503: "Service Unavailable",
+}
+MAX_BODY_BYTES = 8 << 20
+
+
+class EngineRunner:
+    """Owns the engine tick loop on a worker thread and bridges it to
+    asyncio handlers.
+
+    Commands (submit/abort) are drained at the top of every loop
+    iteration, then one ``engine.step()`` runs if there is work;  when
+    idle the loop blocks on the command queue (no spin).  Events flow
+    back per request: ``("accepted",)`` / ``("rejected", retry_after)`` /
+    ``("error", msg)`` on the admission verdict, ``("token", id, delta)``
+    per generated token, ``("finish", reason, final_text_delta)``
+    terminally.
+    """
+
+    def __init__(self, engine: Any, *, request_timeout: float | None = None,
+                 idle_poll_s: float = 0.02,
+                 metrics_max_samples: int = 100_000) -> None:
+        self.engine = engine
+        self.request_timeout = request_timeout
+        self.idle_poll_s = idle_poll_s
+        # a server runs for weeks: bound the metrics sample lists
+        # (counters stay exact; percentiles become a recent window) and
+        # trim the scheduler's terminal-request ledgers below — nothing
+        # in the HTTP layer reads them, and each entry pins its prompt
+        # array and callback closures
+        engine.metrics.max_samples = metrics_max_samples
+        self._cmds: queue_mod.Queue = queue_mod.Queue()
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        # rid → (loop, asyncio.Queue); written by both threads, but each
+        # rid is registered exactly once (submit) and removed exactly
+        # once (engine thread, on the terminal event / reject)
+        self._live: dict[int, tuple[asyncio.AbstractEventLoop,
+                                    asyncio.Queue]] = {}
+        self._rid = itertools.count(getattr(engine, "_next_id", 0))
+        # set when the tick thread dies on an unexpected exception: the
+        # server turns /healthz unhealthy and rejects new work instead
+        # of silently wedging every stream
+        self.crashed: str | None = None
+
+    # -- event-loop side ----------------------------------------------
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._run, name="serve-engine-tick", daemon=True,
+        )
+        self._thread.start()
+
+    def stop(self, timeout: float = 10.0) -> None:
+        self._stop.set()
+        self._cmds.put(("wake",))
+        if self._thread is not None:
+            self._thread.join(timeout=timeout)
+
+    @property
+    def inflight(self) -> int:
+        """Live bridged requests (accepted, not yet terminal)."""
+        return len(self._live)
+
+    def next_rid(self) -> int:
+        return next(self._rid)
+
+    def submit(self, rid: int, payload: Any,
+               loop: asyncio.AbstractEventLoop, aq: asyncio.Queue) -> None:
+        self._live[rid] = (loop, aq)
+        self._cmds.put(("submit", rid, payload))
+        # crash race: if the tick thread died between the handler's
+        # pre-check and this registration, its backstop flush may have
+        # already run — nobody will ever answer this command, so answer
+        # it here (a duplicate event from the flush is harmless: the
+        # handler stops at the first terminal one)
+        if self.crashed and self._live.pop(rid, None) is not None:
+            aq.put_nowait(("error",
+                           f"engine tick thread crashed: {self.crashed}"))
+
+    def abort(self, rid: int) -> None:
+        self._cmds.put(("abort", rid))
+
+    def abort_all(self) -> None:
+        self._cmds.put(("abort_all",))
+
+    # -- engine-thread side -------------------------------------------
+    def _push(self, rid: int, item: tuple) -> None:
+        ent = self._live.get(rid)
+        if ent is None:
+            return
+        loop, aq = ent
+        try:
+            loop.call_soon_threadsafe(aq.put_nowait, item)
+        except RuntimeError:
+            # loop already closed (shutdown race) — nobody is reading
+            self._live.pop(rid, None)
+
+    def _exec(self, cmd: tuple) -> None:
+        kind = cmd[0]
+        if kind == "submit":
+            _, rid, payload = cmd
+            deadline = payload.timeout_s
+            if self.request_timeout is not None:
+                deadline = min(deadline or self.request_timeout,
+                               self.request_timeout)
+
+            def cb(req: Any, tok: int, delta: str | None) -> None:
+                self._push(req.req_id, ("token", int(tok), delta))
+
+            def on_event(req: Any, event: str) -> None:
+                if event in TERMINAL_EVENTS:
+                    self._push(req.req_id, (
+                        "finish", event,
+                        req.extra.pop("final_text_delta", None),
+                    ))
+                    self._live.pop(req.req_id, None)
+
+            try:
+                self.engine.submit(
+                    payload.prompt_ids, payload.max_tokens,
+                    request_id=rid, seed=payload.seed, callback=cb,
+                    on_event=on_event, deadline_s=deadline,
+                )
+            except QueueFull:
+                self._push(rid, ("rejected", 1))
+                self._live.pop(rid, None)
+            except ValueError as e:
+                self._push(rid, ("error", str(e)))
+                self._live.pop(rid, None)
+            else:
+                self._push(rid, ("accepted",))
+        elif kind == "abort":
+            self.engine.abort(cmd[1])
+        elif kind == "abort_all":
+            for rid in list(self._live):
+                self.engine.abort(rid)
+
+    def _run(self) -> None:
+        engine = self.engine
+        try:
+            while not self._stop.is_set():
+                try:
+                    block = not engine.scheduler.has_work
+                    cmd = self._cmds.get(
+                        block=block,
+                        timeout=self.idle_poll_s if block else None,
+                    )
+                except queue_mod.Empty:
+                    cmd = None
+                while cmd is not None:
+                    if cmd[0] != "wake":
+                        self._exec(cmd)
+                    try:
+                        cmd = self._cmds.get_nowait()
+                    except queue_mod.Empty:
+                        cmd = None
+                if self._stop.is_set():
+                    break
+                if engine.scheduler.has_work:
+                    engine.step()
+                    # terminal requests already delivered their events
+                    # through the bridge — dropping them here keeps a
+                    # long-running server's memory flat
+                    engine.scheduler.finished.clear()
+                    engine.scheduler.aborted.clear()
+        except BaseException as e:  # noqa: BLE001 — last-resort backstop
+            # A dead tick thread must not wedge the server: every
+            # in-flight stream gets a terminal event (clients see a
+            # clean end instead of hanging until their own timeouts),
+            # /healthz flips unhealthy, and new submits are refused.
+            self.crashed = f"{type(e).__name__}: {e}"
+            import traceback
+
+            traceback.print_exc()
+            for rid in list(self._live):
+                self._push(rid, ("finish", "aborted", None))
+                self._live.pop(rid, None)
+
+
+class HttpServer:
+    """The asyncio front: routing, SSE streaming, drain shutdown."""
+
+    def __init__(
+        self,
+        engine: Any,
+        *,
+        model_id: str,
+        tokenizer: Any = None,
+        request_timeout: float | None = None,
+        drain_timeout: float = 30.0,
+        default_max_tokens: int = 16,
+        max_tokens_cap: int | None = None,
+    ) -> None:
+        self.engine = engine
+        self.model_id = model_id
+        self.tokenizer = tokenizer if tokenizer is not None \
+            else getattr(engine, "tokenizer", None)
+        self.drain_timeout = drain_timeout
+        self.default_max_tokens = default_max_tokens
+        self.max_tokens_cap = max_tokens_cap
+        self.runner = EngineRunner(engine, request_timeout=request_timeout)
+        self.draining = False
+        self.host: str | None = None
+        self.port: int | None = None
+        self._server: asyncio.AbstractServer | None = None
+        self._loop: asyncio.AbstractEventLoop | None = None
+        self._done: asyncio.Event | None = None
+        self._drain_task: asyncio.Task | None = None
+        self._conn_tasks: set[asyncio.Task] = set()
+        self._signals: list[int] = []
+
+    # ------------------------------------------------------------------
+    async def start(self, host: str = "127.0.0.1", port: int = 0) -> None:
+        self._loop = asyncio.get_running_loop()
+        self._done = asyncio.Event()
+        self.runner.start()
+        self._server = await asyncio.start_server(self._on_conn, host, port)
+        sock = self._server.sockets[0]
+        self.host, self.port = sock.getsockname()[:2]
+        for sig in (signal.SIGTERM, signal.SIGINT):
+            try:
+                self._loop.add_signal_handler(sig, self.begin_drain)
+                self._signals.append(sig)
+            except (NotImplementedError, RuntimeError, ValueError):
+                # not the main thread (CLI smoke tests run the server in
+                # a worker thread) or an embedded loop — drain stays
+                # reachable programmatically
+                break
+
+    def begin_drain(self) -> None:
+        """Idempotent shutdown trigger — the SIGTERM handler and the
+        test hook both land here."""
+        if self._drain_task is None and self._loop is not None:
+            self._drain_task = self._loop.create_task(self._drain())
+
+    async def _drain(self) -> None:
+        self.draining = True
+        loop = asyncio.get_running_loop()
+        deadline = loop.time() + self.drain_timeout
+        while self.runner.inflight and loop.time() < deadline:
+            await asyncio.sleep(0.02)
+        if self.runner.inflight:
+            self.runner.abort_all()
+            grace = loop.time() + 5.0
+            while self.runner.inflight and loop.time() < grace:
+                await asyncio.sleep(0.02)
+        # every stream got its terminal event; give the handlers a
+        # bounded window to flush their last bytes BEFORE the socket
+        # closes (the acceptance criterion for drain)
+        flush_deadline = loop.time() + 5.0
+        while self._conn_tasks and loop.time() < flush_deadline:
+            await asyncio.sleep(0.02)
+        assert self._server is not None
+        self._server.close()
+        await self._server.wait_closed()
+        for sig in self._signals:
+            with contextlib.suppress(Exception):
+                self._loop.remove_signal_handler(sig)  # type: ignore[union-attr]
+        self.runner.stop()
+        assert self._done is not None
+        self._done.set()
+
+    async def serve_until_shutdown(self) -> None:
+        assert self._done is not None, "call start() first"
+        await self._done.wait()
+
+    # ------------------------------------------------------------------
+    async def _on_conn(self, reader: asyncio.StreamReader,
+                       writer: asyncio.StreamWriter) -> None:
+        task = asyncio.current_task()
+        if task is not None:
+            self._conn_tasks.add(task)
+        try:
+            await self._handle(reader, writer)
+        except (ConnectionResetError, BrokenPipeError, asyncio.TimeoutError):
+            pass
+        finally:
+            if task is not None:
+                self._conn_tasks.discard(task)
+            with contextlib.suppress(Exception):
+                writer.close()
+                await writer.wait_closed()
+
+    async def _handle(self, reader: asyncio.StreamReader,
+                      writer: asyncio.StreamWriter) -> None:
+        try:
+            method, path, headers, body = await asyncio.wait_for(
+                self._read_request(reader), timeout=30.0,
+            )
+        except HTTPError as e:
+            await self._respond_error(writer, e)
+            return
+        except (asyncio.IncompleteReadError, ValueError,
+                asyncio.TimeoutError):
+            return  # torn/oversized request line — nothing to answer
+        if method == "GET" and path == "/healthz":
+            crashed = self.runner.crashed
+            status = 503 if (self.draining or crashed) else 200
+            state = ("crashed" if crashed
+                     else "draining" if self.draining else "ok")
+            payload = {"status": state, "model": self.model_id}
+            if crashed:
+                payload["error"] = crashed
+            await self._respond(writer, status, json.dumps(payload).encode())
+        elif method == "GET" and path == "/metrics":
+            await self._respond(
+                writer, 200, self._render_metrics().encode(),
+                content_type="text/plain; version=0.0.4; charset=utf-8",
+            )
+        elif path == "/v1/completions":
+            if method != "POST":
+                await self._respond_error(writer, HTTPError(
+                    405, "use POST for /v1/completions"))
+            else:
+                await self._completions(reader, writer, body)
+        else:
+            await self._respond_error(writer, HTTPError(
+                404, f"no route for {method} {path}"))
+
+    async def _read_request(
+        self, reader: asyncio.StreamReader,
+    ) -> tuple[str, str, dict[str, str], bytes]:
+        line = await reader.readline()
+        if not line:
+            raise asyncio.IncompleteReadError(b"", None)
+        parts = line.decode("latin-1").split()
+        if len(parts) != 3:
+            raise HTTPError(400, "malformed request line")
+        method, path = parts[0].upper(), parts[1]
+        headers: dict[str, str] = {}
+        while True:
+            hline = await reader.readline()
+            if hline in (b"\r\n", b"\n", b""):
+                break
+            key, _, value = hline.decode("latin-1").partition(":")
+            headers[key.strip().lower()] = value.strip()
+        try:
+            n = int(headers.get("content-length", "0"))
+        except ValueError as e:
+            raise HTTPError(400, "bad Content-Length") from e
+        if n > MAX_BODY_BYTES:
+            raise HTTPError(413, f"body exceeds {MAX_BODY_BYTES} bytes")
+        body = await reader.readexactly(n) if n else b""
+        return method, path, headers, body
+
+    def _render_metrics(self) -> str:
+        stats = self.engine.pool.stats()
+        return self.engine.metrics.prometheus(extra_gauges={
+            "pool_blocks_free": stats["free"],
+            "pool_blocks_request_held": stats["request_held"],
+            "pool_blocks_cache_only": stats["cache_only"],
+            "inflight_streams": self.runner.inflight,
+            "queue_depth_live": self.engine.scheduler.queue_depth,
+            "draining": 1.0 if self.draining else 0.0,
+        })
+
+    # ------------------------------------------------------------------
+    async def _completions(self, reader: asyncio.StreamReader,
+                           writer: asyncio.StreamWriter,
+                           body: bytes) -> None:
+        if self.draining or self.runner.crashed:
+            msg = ("engine tick thread crashed: " + self.runner.crashed
+                   if self.runner.crashed
+                   else "server is draining for shutdown")
+            await self._respond_error(writer, HTTPError(
+                503, msg, etype="server_error",
+                headers=(("Retry-After", "1"),),
+            ))
+            return
+        try:
+            payload = parse_completion_request(
+                body, model_id=self.model_id, tokenizer=self.tokenizer,
+                default_max_tokens=self.default_max_tokens,
+                max_tokens_cap=self.max_tokens_cap,
+            )
+        except HTTPError as e:
+            await self._respond_error(writer, e)
+            return
+
+        loop = asyncio.get_running_loop()
+        aq: asyncio.Queue = asyncio.Queue()
+        rid = self.runner.next_rid()
+        self.runner.submit(rid, payload, loop, aq)
+        verdict = await aq.get()
+        if verdict[0] == "rejected":
+            await self._respond_error(writer, HTTPError(
+                429, "request queue is full; retry later",
+                etype="rate_limit_error",
+                headers=(("Retry-After", str(verdict[1])),),
+            ))
+            return
+        if verdict[0] == "error":
+            await self._respond_error(writer, HTTPError(400, verdict[1]))
+            return
+        if verdict[0] == "finish":
+            # terminal before acceptance: only the tick-thread crash
+            # backstop produces this — the request never ran
+            await self._respond_error(writer, HTTPError(
+                503, "engine tick thread crashed before the request "
+                "was accepted", etype="server_error",
+            ))
+            return
+        created = int(time.time())
+        # Disconnect watch: drain (and DISCARD, bounded-memory) anything
+        # else the client sends — we are Connection: close, so stray
+        # bytes are pipelining we don't support — and complete only at
+        # EOF, which for an HTTP/1.1 client means it hung up → abort.
+        # (A client that half-closes its write side after the body is
+        # indistinguishable from a disconnect here and is also aborted;
+        # real HTTP clients don't half-close.)
+        monitor = asyncio.ensure_future(self._watch_disconnect(reader))
+        try:
+            if payload.stream:
+                await self._stream_response(
+                    writer, aq, monitor, rid, payload, created)
+            else:
+                await self._unary_response(
+                    writer, aq, monitor, rid, payload, created)
+        finally:
+            monitor.cancel()
+            with contextlib.suppress(asyncio.CancelledError):
+                await monitor
+
+    @staticmethod
+    async def _watch_disconnect(reader: asyncio.StreamReader) -> None:
+        while True:
+            data = await reader.read(4096)
+            if not data:
+                return
+
+    async def _next_event(self, aq: asyncio.Queue,
+                          monitor: asyncio.Future) -> tuple | None:
+        """Next engine event, or None if the client disconnected first."""
+        getter = asyncio.ensure_future(aq.get())
+        done, _ = await asyncio.wait(
+            {getter, monitor}, return_when=asyncio.FIRST_COMPLETED,
+        )
+        if getter in done:
+            return getter.result()
+        getter.cancel()
+        with contextlib.suppress(asyncio.CancelledError):
+            await getter
+        return None
+
+    async def _stream_response(self, writer, aq, monitor, rid,
+                               payload, created) -> None:
+        try:
+            writer.write(
+                b"HTTP/1.1 200 OK\r\n"
+                b"Content-Type: text/event-stream\r\n"
+                b"Cache-Control: no-cache\r\n"
+                b"Connection: close\r\n\r\n"
+            )
+            await writer.drain()
+        except (ConnectionResetError, BrokenPipeError, OSError):
+            # gone before the first byte: the request must not keep its
+            # decode slot generating for a dead socket
+            self.runner.abort(rid)
+            return
+        while True:
+            ev = await self._next_event(aq, monitor)
+            if ev is None:  # client went away mid-stream
+                self.runner.abort(rid)
+                return
+            if ev[0] == "token":
+                _, tok, delta = ev
+                frame = sse_event(chunk_payload(
+                    rid, payload.echo_model, created,
+                    text=delta or "", token_id=tok, finish_reason=None,
+                ))
+            else:  # ("finish", reason, tail)
+                _, reason, tail = ev
+                frame = sse_event(chunk_payload(
+                    rid, payload.echo_model, created,
+                    text=tail or "", token_id=None, finish_reason=reason,
+                )) + DONE_SENTINEL
+            try:
+                writer.write(frame)
+                await writer.drain()
+            except (ConnectionResetError, BrokenPipeError, OSError):
+                self.runner.abort(rid)
+                return
+            if ev[0] == "finish":
+                return
+
+    async def _unary_response(self, writer, aq, monitor, rid,
+                              payload, created) -> None:
+        token_ids: list[int] = []
+        text_parts: list[str] = []
+        while True:
+            ev = await self._next_event(aq, monitor)
+            if ev is None:
+                self.runner.abort(rid)
+                return
+            if ev[0] == "token":
+                token_ids.append(ev[1])
+                if ev[2]:
+                    text_parts.append(ev[2])
+            else:
+                reason, tail = ev[1], ev[2]
+                if tail:
+                    text_parts.append(tail)
+                break
+        body = json.dumps(completion_payload(
+            rid, payload.echo_model, created,
+            text="".join(text_parts), token_ids=token_ids,
+            finish_reason=reason,
+            prompt_tokens=int(payload.prompt_ids.size),
+        )).encode()
+        await self._respond(writer, 200, body)
+
+    # ------------------------------------------------------------------
+    async def _respond(self, writer: asyncio.StreamWriter, status: int,
+                       body: bytes,
+                       content_type: str = "application/json",
+                       extra_headers: tuple = ()) -> None:
+        head = (
+            f"HTTP/1.1 {status} {_REASONS.get(status, '')}\r\n"
+            f"Content-Type: {content_type}\r\n"
+            f"Content-Length: {len(body)}\r\n"
+            "Connection: close\r\n"
+        )
+        for key, value in extra_headers:
+            head += f"{key}: {value}\r\n"
+        writer.write(head.encode() + b"\r\n" + body)
+        with contextlib.suppress(ConnectionResetError, BrokenPipeError,
+                                 OSError):
+            await writer.drain()
+
+    async def _respond_error(self, writer: asyncio.StreamWriter,
+                             e: HTTPError) -> None:
+        await self._respond(
+            writer, e.status, error_body(e.message, e.etype, e.code),
+            extra_headers=tuple(e.headers),
+        )
+
+
+async def run_server(
+    engine: Any,
+    *,
+    model_id: str,
+    tokenizer: Any = None,
+    host: str = "127.0.0.1",
+    port: int = 8000,
+    request_timeout: float | None = None,
+    drain_timeout: float = 30.0,
+    default_max_tokens: int = 16,
+    max_tokens_cap: int | None = None,
+    port_file: str | None = None,
+    exit_after_s: float | None = None,
+    on_started: Any = None,
+) -> HttpServer:
+    """Start serving and block until drain shutdown completes."""
+    server = HttpServer(
+        engine, model_id=model_id, tokenizer=tokenizer,
+        request_timeout=request_timeout, drain_timeout=drain_timeout,
+        default_max_tokens=default_max_tokens,
+        max_tokens_cap=max_tokens_cap,
+    )
+    await server.start(host, port)
+    if port_file:
+        with open(port_file, "w") as f:
+            f.write(f"{server.host} {server.port}\n")
+    if exit_after_s is not None:
+        asyncio.get_running_loop().call_later(
+            exit_after_s, server.begin_drain)
+    if on_started is not None:
+        on_started(server)
+    await server.serve_until_shutdown()
+    return server
+
+
+def serve_forever(engine: Any, **kwargs: Any) -> None:
+    """Synchronous entry for the CLI: run the server on a fresh event
+    loop until a drain shutdown (SIGTERM/SIGINT) completes."""
+    asyncio.run(run_server(engine, **kwargs))

@@ -1,8 +1,8 @@
 """Serving metrics: what an operator needs to see on one screen.
 
 Collected by ``ServeEngine`` per tick and per request, exported as one
-flat dict (``snapshot()``) so the CLI, bench.py, and tests consume the
-same numbers:
+flat dict (``snapshot()``) so the CLI, bench.py, tests, and the HTTP
+``/metrics`` endpoint consume the same numbers:
 
 - ``queue_depth_*``        — requests waiting (sampled per tick)
 - ``ttft_s_*``             — arrival (realtime replay) or submit → first
@@ -12,6 +12,10 @@ same numbers:
 - ``occupancy_*``          — fraction of allocatable blocks held
 - ``active_slots_*``       — decode slots busy (batch efficiency)
 - ``preemptions``          — evict-on-OOM count (requeues)
+- ``aborted`` / ``rejected`` — cancelled requests (client disconnect or
+                             deadline) and queue-full admission rejects
+- ``finish_reasons``       — terminal outcome counts by reason
+                             (``stop``/``length``/``aborted``)
 - ``throughput_tok_s``     — total generated tokens / wall span
 - ``prefix_hit_rate``      — prompt blocks reused from the prefix cache
                              / shareable prompt blocks requested
@@ -21,14 +25,22 @@ same numbers:
                              the paged kernel only each row's visible
                              blocks)
 
-Percentiles are p50/p90/p99 over whatever was recorded — no windowing;
-a serving front-end would wire these into a real metrics sink
-(ROADMAP follow-up).
+Percentiles are p50/p90/p99 over whatever was recorded — no windowing.
+
+THREAD SAFETY: the engine tick loop mutates these counters from its own
+thread while the HTTP scrape handler renders them from the event loop —
+every record hook and ``snapshot()`` serialize on one lock, and
+``snapshot()`` copies the value lists before computing percentiles, so a
+scrape always sees a consistent point-in-time view (copy-on-read).
+``prometheus()`` renders the text exposition format (0.0.4) from that
+same snapshot.
 """
 
 from __future__ import annotations
 
+import threading
 import time
+from collections import Counter
 from typing import Any
 
 import numpy as np
@@ -49,15 +61,27 @@ def _pcts(values: list[float], name: str) -> dict[str, float]:
 
 
 class ServeMetrics:
-    def __init__(self, clock=time.perf_counter) -> None:
+    def __init__(self, clock=time.perf_counter,
+                 max_samples: int | None = None) -> None:
         self.clock = clock
+        self._lock = threading.Lock()
+        # bounded-retention mode for long-running servers: None (bench/
+        # test traces — exact full-trace percentiles) keeps every sample;
+        # an int caps each value list, dropping the oldest half on
+        # overflow (percentiles become a recent-window view; counters
+        # stay exact forever).  The HTTP runner sets this — an unbounded
+        # list per tick would leak for the server's whole lifetime.
+        self.max_samples = max_samples
         self.t_start = clock()
         self.t_last: float | None = None
         self.n_submitted = 0
         self.n_finished = 0
+        self.n_aborted = 0
+        self.n_rejected = 0
         self.n_ticks = 0
         self.preemptions = 0
         self.total_generated = 0
+        self.finish_reasons: Counter[str] = Counter()
         self.ttft_s: list[float] = []
         self.decode_tok_s: list[float] = []
         self.queue_depth: list[int] = []
@@ -69,38 +93,70 @@ class ServeMetrics:
 
     # -- record hooks (engine calls these) -----------------------------
     def on_submit(self, req: Request) -> None:
-        if self.n_submitted == 0:
-            # wall span starts at first traffic, not engine build — idle
-            # time before the first request must not deflate throughput
-            self.t_start = self.clock()
-        self.n_submitted += 1
+        with self._lock:
+            if self.n_submitted == 0:
+                # wall span starts at first traffic, not engine build —
+                # idle time before the first request must not deflate
+                # throughput
+                self.t_start = self.clock()
+            self.n_submitted += 1
+
+    def on_reject(self) -> None:
+        """A submit bounced off the queue-depth cap (HTTP 429)."""
+        with self._lock:
+            self.n_rejected += 1
+
+    def _trim(self, values: list) -> None:
+        # caller holds the lock
+        if self.max_samples is not None and len(values) > self.max_samples:
+            del values[: len(values) // 2]
 
     def on_tick(
         self, *, queue_depth: int, occupancy: float, active_slots: int,
         preemptions_total: int, kv_bytes: int = 0,
     ) -> None:
-        self.n_ticks += 1
-        self.t_last = self.clock()
-        self.queue_depth.append(queue_depth)
-        self.occupancy.append(occupancy)
-        self.active_slots.append(active_slots)
-        self.preemptions = preemptions_total
-        if active_slots:
-            # only decode ticks stream cache; idle/admission-only ticks
-            # would dilute the per-tick gauge with zeros
-            self.kv_bytes_tick.append(float(kv_bytes))
+        with self._lock:
+            self.n_ticks += 1
+            self.t_last = self.clock()
+            self.queue_depth.append(queue_depth)
+            self.occupancy.append(occupancy)
+            self.active_slots.append(active_slots)
+            self.preemptions = preemptions_total
+            if active_slots:
+                # only decode ticks stream cache; idle/admission-only
+                # ticks would dilute the per-tick gauge with zeros
+                self.kv_bytes_tick.append(float(kv_bytes))
+            for vals in (self.queue_depth, self.occupancy,
+                         self.active_slots, self.kv_bytes_tick):
+                self._trim(vals)
 
     def on_prefix(self, *, requested: int, hits: int) -> None:
         """One prefill's prefix-cache outcome: ``requested`` shareable
         prompt blocks were looked up, ``hits`` were reused."""
-        self.prefix_blocks_requested += requested
-        self.prefix_blocks_hit += hits
+        with self._lock:
+            self.prefix_blocks_requested += requested
+            self.prefix_blocks_hit += hits
 
     def on_token(self, req: Request) -> None:
-        self.total_generated += 1
+        with self._lock:
+            self.total_generated += 1
 
     def on_finish(self, req: Request) -> None:
-        self.n_finished += 1
+        with self._lock:
+            self.n_finished += 1
+            self.finish_reasons[req.finish_reason or "length"] += 1
+            self._record_latencies(req)
+
+    def on_abort(self, req: Request) -> None:
+        """Request cancelled (disconnect or deadline).  Counted apart
+        from ``finished`` — its TTFT still records if a token got out."""
+        with self._lock:
+            self.n_aborted += 1
+            self.finish_reasons["aborted"] += 1
+            self._record_latencies(req)
+
+    def _record_latencies(self, req: Request) -> None:
+        # caller holds the lock
         if req.submit_time is not None and req.first_token_time is not None:
             # realtime replay records the wall arrival, so TTFT includes
             # the wait before the tick loop noticed the request; the
@@ -108,37 +164,141 @@ class ServeMetrics:
             # virtual-mode TTFT is based at submit
             base = req.extra.get("arrival_wall", req.submit_time)
             self.ttft_s.append(req.first_token_time - base)
+            self._trim(self.ttft_s)
             n_after_first = len(req.generated) - 1
             span = (req.finish_time or self.clock()) - req.first_token_time
             if n_after_first > 0 and span > 0:
                 self.decode_tok_s.append(n_after_first / span)
+                self._trim(self.decode_tok_s)
 
     # ------------------------------------------------------------------
     def snapshot(self) -> dict[str, Any]:
-        span = (self.t_last or self.clock()) - self.t_start
-        out: dict[str, Any] = {
-            "submitted": self.n_submitted,
-            "finished": self.n_finished,
-            "ticks": self.n_ticks,
-            "preemptions": self.preemptions,
-            "total_generated_tokens": self.total_generated,
-            "throughput_tok_s": self.total_generated / span if span > 0 else 0.0,
-            "wall_s": span,
-        }
-        out.update(_pcts(self.ttft_s, "ttft_s"))
-        out.update(_pcts(self.decode_tok_s, "decode_tok_s"))
-        out.update(_pcts([float(q) for q in self.queue_depth], "queue_depth"))
-        out.update(_pcts(self.occupancy, "occupancy"))
-        out.update(_pcts([float(a) for a in self.active_slots], "active_slots"))
-        out.update(_pcts(self.kv_bytes_tick, "kv_bytes_tick"))
-        out["kv_bytes_total"] = float(sum(self.kv_bytes_tick))
-        out["prefix_blocks_requested"] = self.prefix_blocks_requested
-        out["prefix_blocks_hit"] = self.prefix_blocks_hit
-        if self.prefix_blocks_requested:
-            out["prefix_hit_rate"] = (
-                self.prefix_blocks_hit / self.prefix_blocks_requested
-            )
+        with self._lock:
+            span = (self.t_last or self.clock()) - self.t_start
+            out: dict[str, Any] = {
+                "submitted": self.n_submitted,
+                "finished": self.n_finished,
+                "aborted": self.n_aborted,
+                "rejected": self.n_rejected,
+                "ticks": self.n_ticks,
+                "preemptions": self.preemptions,
+                "total_generated_tokens": self.total_generated,
+                "throughput_tok_s": (
+                    self.total_generated / span if span > 0 else 0.0
+                ),
+                "wall_s": span,
+                "finish_reasons": dict(self.finish_reasons),
+            }
+            # copy-on-read: percentile math sees frozen lists even while
+            # the tick loop keeps appending
+            ttft = list(self.ttft_s)
+            decode = list(self.decode_tok_s)
+            qd = [float(q) for q in self.queue_depth]
+            occ = list(self.occupancy)
+            act = [float(a) for a in self.active_slots]
+            kvb = list(self.kv_bytes_tick)
+            prefix_req = self.prefix_blocks_requested
+            prefix_hit = self.prefix_blocks_hit
+        out.update(_pcts(ttft, "ttft_s"))
+        out.update(_pcts(decode, "decode_tok_s"))
+        out.update(_pcts(qd, "queue_depth"))
+        out.update(_pcts(occ, "occupancy"))
+        out.update(_pcts(act, "active_slots"))
+        out.update(_pcts(kvb, "kv_bytes_tick"))
+        # *_last: the most recent per-tick sample — the live gauge a
+        # scrape wants, vs the trace-wide percentiles above
+        if qd:
+            out["queue_depth_last"] = qd[-1]
+        if occ:
+            out["occupancy_last"] = occ[-1]
+        if act:
+            out["active_slots_last"] = act[-1]
+        out["kv_bytes_total"] = float(sum(kvb))
+        out["prefix_blocks_requested"] = prefix_req
+        out["prefix_blocks_hit"] = prefix_hit
+        if prefix_req:
+            out["prefix_hit_rate"] = prefix_hit / prefix_req
         return out
+
+    # ------------------------------------------------------------------
+    def prometheus(
+        self, extra_gauges: dict[str, float] | None = None,
+        prefix: str = "llm_serve",
+    ) -> str:
+        """Text exposition format (0.0.4) for a ``GET /metrics`` scrape.
+
+        Rendered from ``snapshot()`` (so a scrape is one locked copy, no
+        torn reads).  ``extra_gauges`` lets the HTTP server add live
+        gauges the metrics object cannot know (current queue depth, pool
+        free blocks, in-flight streams).
+        """
+        s = self.snapshot()
+        lines: list[str] = []
+
+        def emit(name: str, mtype: str, help_: str,
+                 samples: list[tuple[str, float]]) -> None:
+            full = f"{prefix}_{name}"
+            lines.append(f"# HELP {full} {help_}")
+            lines.append(f"# TYPE {full} {mtype}")
+            for labels, value in samples:
+                lines.append(f"{full}{labels} {value:.10g}")
+
+        emit("requests_submitted_total", "counter",
+             "Requests accepted into the scheduler queue",
+             [("", s["submitted"])])
+        emit("requests_finished_total", "counter",
+             "Requests that ran to a natural finish",
+             [("", s["finished"])])
+        emit("requests_aborted_total", "counter",
+             "Requests cancelled (client disconnect or deadline)",
+             [("", s["aborted"])])
+        emit("requests_rejected_total", "counter",
+             "Submits bounced off the queue-depth cap (HTTP 429)",
+             [("", s["rejected"])])
+        emit("finish_total", "counter",
+             "Terminal events by finish reason",
+             [(f'{{reason="{r}"}}', n)
+              for r, n in sorted(s["finish_reasons"].items())] or
+             [('{reason="stop"}', 0)])
+        emit("preemptions_total", "counter",
+             "Evict-on-OOM requeues", [("", s["preemptions"])])
+        emit("tokens_generated_total", "counter",
+             "Generated tokens across all requests",
+             [("", s["total_generated_tokens"])])
+        emit("ticks_total", "counter",
+             "Scheduler ticks", [("", s["ticks"])])
+        emit("queue_depth", "gauge",
+             "Requests waiting for admission (last tick sample)",
+             [("", s.get("queue_depth_last", 0.0))])
+        emit("pool_occupancy", "gauge",
+             "Fraction of allocatable KV blocks held (last tick sample)",
+             [("", s.get("occupancy_last", 0.0))])
+        emit("active_slots", "gauge",
+             "Decode slots busy (last tick sample)",
+             [("", s.get("active_slots_last", 0.0))])
+        emit("prefix_hit_rate", "gauge",
+             "Prompt blocks reused from the prefix cache / shareable "
+             "blocks requested",
+             [("", s.get("prefix_hit_rate", 0.0))])
+        emit("kv_bytes_tick_mean", "gauge",
+             "Mean K/V bytes decode attention touches per tick",
+             [("", s.get("kv_bytes_tick_mean", 0.0))])
+        emit("throughput_tok_s", "gauge",
+             "Generated tokens per second over the traffic span",
+             [("", s["throughput_tok_s"])])
+        ttft = [(f'{{quantile="{q}"}}', s[f"ttft_s_{p}"])
+                for q, p in (("0.5", "p50"), ("0.9", "p90"), ("0.99", "p99"))
+                if f"ttft_s_{p}" in s]
+        if ttft:
+            with self._lock:
+                ttft_sum, ttft_n = sum(self.ttft_s), len(self.ttft_s)
+            emit("ttft_seconds", "summary",
+                 "Submit/arrival to first token, per request", ttft)
+            lines.append(f"{prefix}_ttft_seconds_sum {ttft_sum:.10g}")
+            lines.append(f"{prefix}_ttft_seconds_count {ttft_n}")
+        for key, value in (extra_gauges or {}).items():
+            emit(key, "gauge", "Live server gauge", [("", float(value))])
+        return "\n".join(lines) + "\n"
 
     def format(self) -> str:
         """One operator-readable block (the CLI prints this)."""
@@ -156,8 +316,14 @@ class ServeMetrics:
             f"({s['prefix_blocks_hit']}/{s['prefix_blocks_requested']} blocks)"
             if "prefix_hit_rate" in s else "-"
         )
+        aborts = (
+            f", {s['aborted']} aborted" if s["aborted"] else ""
+        ) + (
+            f", {s['rejected']} rejected" if s["rejected"] else ""
+        )
         return (
-            f"requests: {s['submitted']} submitted, {s['finished']} finished, "
+            f"requests: {s['submitted']} submitted, {s['finished']} finished"
+            f"{aborts}, "
             f"{s['preemptions']} preemptions over {s['ticks']} ticks\n"
             f"throughput: {s['throughput_tok_s']:.1f} tok/s total "
             f"({s['total_generated_tokens']} tokens in {s['wall_s']:.2f}s)\n"

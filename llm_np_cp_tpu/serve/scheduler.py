@@ -12,6 +12,15 @@ Policies (deliberately boring — the interesting state is in the pool):
   decode slot is free AND the pool can allocate its prefill blocks while
   keeping ``decode_reserve`` blocks spare (so a fresh admission cannot
   instantly OOM the running set).  No queue-jumping → no starvation.
+- **Backpressure**: an optional ``max_queue`` depth cap — ``add`` raises
+  ``QueueFull`` instead of growing the queue without bound (the HTTP
+  front-end maps it to 429 + Retry-After).  Preemption requeues are
+  EXEMPT: they re-enter at the front and were already admitted once, so
+  the cap can never deadlock the running set.
+- **Abort**: a request can be cancelled in any live state.  Queued
+  requests just leave the queue (they hold no blocks); running requests
+  release their slot and decref their blocks — shared prefix blocks
+  survive for their other holders exactly as on finish/eviction.
 - **Growth**: before each decode tick every running request whose next
   token would overflow its allocated blocks gets one more block.
 - **Eviction**: if that allocation fails, the *youngest* running request
@@ -44,6 +53,22 @@ class RequestState(enum.Enum):
     QUEUED = "queued"
     RUNNING = "running"
     FINISHED = "finished"
+    ABORTED = "aborted"
+
+
+class QueueFull(RuntimeError):
+    """Admission rejected: the scheduler's queue-depth cap is reached.
+
+    Deliberately NOT a ValueError — callers must be able to tell "this
+    request can never run" (ValueError at submit) apart from "try again
+    later" (this), because only the latter maps to HTTP 429."""
+
+    def __init__(self, depth: int, cap: int) -> None:
+        super().__init__(
+            f"scheduler queue is full ({depth} waiting, cap {cap})"
+        )
+        self.depth = depth
+        self.cap = cap
 
 
 @dataclasses.dataclass
@@ -60,6 +85,16 @@ class Request:
 
     # -- scheduler/engine state ---------------------------------------
     state: RequestState = RequestState.QUEUED
+    # terminal outcome: "stop" | "length" | "aborted" (None while live);
+    # the SAME vocabulary flows through engine events, the metrics
+    # snapshot, and the HTTP ``finish_reason`` field
+    finish_reason: str | None = None
+    # absolute deadline on the engine clock; the engine aborts past it
+    deadline: float | None = None
+    # on_event(request, event) — terminal events ("stop"/"length"/
+    # "aborted") plus the non-terminal "evicted-requeued" preemption
+    # notice; token-level streaming stays on ``callback``
+    on_event: Callable[["Request", str], None] | None = None
     generated: list[int] = dataclasses.field(default_factory=list)
     block_ids: list[int] = dataclasses.field(default_factory=list)
     # leading entries of block_ids claimed from the prefix cache (their
@@ -120,9 +155,12 @@ class Scheduler:
         blocks_for_prefill: Callable[[Request], int] | None = None,
         prefill_plan: Callable[[Request], tuple[list[int], int]] | None = None,
         decode_reserve: int = 1,
+        max_queue: int | None = None,
     ) -> None:
         if max_slots < 1:
             raise ValueError(f"max_slots must be >= 1, got {max_slots}")
+        if max_queue is not None and max_queue < 1:
+            raise ValueError(f"max_queue must be >= 1 or None, got {max_queue}")
         self.allocator = allocator
         self.max_slots = max_slots
         self.block_size = block_size
@@ -138,9 +176,11 @@ class Scheduler:
         self._prefill_plan = prefill_plan or (
             lambda req: ([], self._blocks_for_prefill(req))
         )
+        self.max_queue = max_queue
         self.queue: deque[Request] = deque()
         self.running: list[Request] = []  # admission order (oldest first)
         self.finished: list[Request] = []
+        self.aborted: list[Request] = []
         self._free_slots: list[int] = list(range(max_slots - 1, -1, -1))
         self.n_preemptions = 0
 
@@ -154,6 +194,12 @@ class Scheduler:
         return bool(self.queue or self.running)
 
     def add(self, req: Request) -> None:
+        """Enqueue a NEW request; raises ``QueueFull`` past ``max_queue``.
+        Preemption requeues bypass this (``_preempt`` appendleft's
+        directly): a preempted request was already admitted once and must
+        be able to come back, cap or no cap."""
+        if self.max_queue is not None and len(self.queue) >= self.max_queue:
+            raise QueueFull(len(self.queue), self.max_queue)
         req.state = RequestState.QUEUED
         self.queue.append(req)
 
@@ -243,6 +289,33 @@ class Scheduler:
         self.running.remove(req)
         req.state = RequestState.FINISHED
         self.finished.append(req)
+
+    def abort(self, req: Request) -> None:
+        """Cancel a live request in whatever state it is in.
+
+        QUEUED (including a preemption requeue waiting at the front)
+        holds no blocks — it just leaves the queue.  RUNNING releases its
+        decode slot and drops one reference per block: the same decref
+        path as finish/eviction, so prefix blocks shared with other
+        requests survive and only this request's references return to
+        the pool.  Terminal states are a hard error — the caller
+        (``ServeEngine.abort``) filters those, and a double-abort here
+        would double-free blocks."""
+        if req.state is RequestState.QUEUED:
+            self.queue.remove(req)
+        elif req.state is RequestState.RUNNING:
+            self.allocator.free(req.block_ids)
+            req.block_ids = []
+            req.n_shared_blocks = 0
+            self._release_slot(req)
+            self.running.remove(req)
+        else:
+            raise ValueError(
+                f"abort on request {req.req_id} in terminal state "
+                f"{req.state.value}"
+            )
+        req.state = RequestState.ABORTED
+        self.aborted.append(req)
 
     def _release_slot(self, req: Request) -> None:
         if req.slot >= 0:

@@ -28,6 +28,17 @@ except AttributeError:
     ).strip()
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: excluded from the tier-1 run (-m 'not slow')",
+    )
+    config.addinivalue_line(
+        "markers",
+        "http: serve/http tests — they bind 127.0.0.1:0 (ephemeral "
+        "loopback ports only), so tier-1 stays hermetic",
+    )
+
+
 @pytest.fixture
 def rng_np():
     return np.random.default_rng(0)

@@ -83,7 +83,7 @@ def test_priority_matches_config_dicts():
         n
         for n in list(bench.DECODE_CONFIGS) + list(bench.SPEC_CONFIGS)
         + list(bench.PREFILL_CONFIGS) + list(bench.RAGGED_CONFIGS)
-        + list(bench.SERVE_CONFIGS)
+        + list(bench.SERVE_CONFIGS) + list(bench.SERVE_HTTP_CONFIGS)
         if not n.startswith("smoke")
     }
     assert set(bench.PRIORITY) == non_smoke | bench.EXTRA_CHILDREN
@@ -97,7 +97,8 @@ def test_warm_smoke_offline():
     assert set(res["warmed"]) == {n for n in bench.PRIORITY
                                  if n not in bench.SPEC_CONFIGS
                                  and n not in bench.EXTRA_CHILDREN
-                                 and n not in bench.SERVE_CONFIGS}
+                                 and n not in bench.SERVE_CONFIGS
+                                 and n not in bench.SERVE_HTTP_CONFIGS}
 
 
 def test_warm_limit_covers_top_priority_only():
@@ -109,7 +110,8 @@ def test_warm_limit_covers_top_priority_only():
                 if n not in bench.SPEC_CONFIGS
                 and n not in bench.EXTRA_CHILDREN
                 and n not in bench.RAGGED_CONFIGS
-                and n not in bench.SERVE_CONFIGS]
+                and n not in bench.SERVE_CONFIGS
+                and n not in bench.SERVE_HTTP_CONFIGS]
     assert res["warmed"] == warmable[:3]
 
 
@@ -132,6 +134,19 @@ def test_serve_smoke_offline():
     assert res["throughput_tok_s"] > 0
     assert res["ttft_s_p50"] > 0
     # jit-stable ticks: ONE decode program regardless of trace length
+    assert res["compile_counts"]["decode_step"] == 1
+
+
+@pytest.mark.http
+def test_serve_http_smoke_offline():
+    """The HTTP loadgen child: the same trace through direct engine calls
+    and the in-process HTTP server (ephemeral loopback port), with token
+    parity between the legs and the overhead delta recorded."""
+    res = bench._spawn("smoke_serve_http", 600, env={"BENCH_PLATFORM": "cpu"})
+    assert res.get("ok") is True, res
+    assert res["token_parity_http_vs_direct"] is True
+    assert res["ttft_s_p50_http"] > res["ttft_s_p50_direct"] > 0
+    assert res["metrics_scrape_ok"] is True
     assert res["compile_counts"]["decode_step"] == 1
 
 

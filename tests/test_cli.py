@@ -472,3 +472,67 @@ def test_cli_serve_bench_rejects_paged_when_probe_fails(fake_load, monkeypatch):
         assert "attn=xla" in out
     finally:
         support._probe.cache_clear()
+
+
+# ---------------------------------------------------------------------------
+# serve: the HTTP front-end subcommand (llm_np_cp_tpu/serve/http/).
+# Marked `http` — binds 127.0.0.1:0 only (ephemeral loopback ports).
+# ---------------------------------------------------------------------------
+
+def test_cli_serve_rejects_bad_flags(fake_load):
+    with pytest.raises(SystemExit, match="multiple of 8"):
+        cli.run(["serve", "--block-size=12"])
+    with pytest.raises(SystemExit, match="max-queue"):
+        cli.run(["serve", "--max-queue=-1"])
+    with pytest.raises(SystemExit, match="request-timeout"):
+        cli.run(["serve", "--request-timeout=-2"])
+
+
+@pytest.mark.http
+def test_cli_serve_http_stdlib_client_smoke(fake_load, tmp_path, capsys):
+    """The whole CLI path end-to-end with STOCK stdlib clients: `serve`
+    binds an ephemeral port, writes --port-file, answers /healthz and a
+    tokenized (string-prompt) completion through http.client, streams
+    SSE to a raw socket reader, and drains on the timed shutdown hook
+    (the same code path as the SIGTERM handler)."""
+    import json
+    import threading
+    import time as _time
+
+    from llm_np_cp_tpu.serve.http.client import http_get, post_completion
+
+    pf = tmp_path / "port"
+    th = threading.Thread(target=cli.run, args=([
+        "serve", "--port=0", "--prompt-len=16", "--max-tokens=8",
+        "--slots=2", "--block-size=8", "--dtype=f32", "--cache-dtype=f32",
+        "--sampler=greedy", f"--port-file={pf}", "--exit-after-s=8",
+        "--request-timeout=5",
+    ],), daemon=True)
+    th.start()
+    deadline = _time.time() + 60
+    while not pf.exists() and _time.time() < deadline:
+        _time.sleep(0.05)
+    assert pf.exists(), "server never wrote --port-file"
+    host, port = pf.read_text().split()
+    port = int(port)
+
+    st, body = http_get(host, port, "/healthz")
+    assert st == 200 and json.loads(body)["status"] == "ok"
+
+    # string prompt → tokenizer path → text comes back detokenized
+    st, obj = post_completion(host, port,
+                              {"prompt": "hello", "max_tokens": 4})
+    assert st == 200
+    choice = obj["choices"][0]
+    assert choice["finish_reason"] == "length"
+    assert len(choice["token_ids"]) == 4
+    assert choice["text"]  # detokenized by the FakeTokenizer
+
+    st, body = http_get(host, port, "/metrics")
+    assert st == 200
+    assert b"llm_serve_requests_finished_total" in body
+
+    th.join(timeout=30)
+    assert not th.is_alive(), "serve did not drain on --exit-after-s"
+    printed = capsys.readouterr().out
+    assert "listening on http://" in printed

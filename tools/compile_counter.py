@@ -175,6 +175,24 @@ def _self_check() -> None:
     )
     print(f"compile counts OK (paged+prefix): {eng.compile_counts()}")
 
+    # abort churn: cancelling requests queued / mid-decode, with prefix
+    # sharers still live, must stay inside the SAME bounds — abort is
+    # host-side unwinding only (tables rebuilt per tick), so decode stays
+    # at ONE compile and no new phase shapes appear
+    warm = dict(eng.compile_counts())
+    for round_ in range(3):
+        live = [eng.submit(p, 6) for p in prompts]
+        eng.step()  # admit + prefill whoever fits
+        eng.abort(live[0].req_id)              # mid-decode (or queued)
+        eng.abort(live[-1].req_id)             # queue tail
+        eng.run_until_complete()
+    assert eng.compile_counts() == warm, (
+        f"abort churn recompiled: {warm} -> {eng.compile_counts()}"
+    )
+    held = eng.pool.stats()["request_held"]
+    assert held == 0, f"abort churn leaked {held} blocks"
+    print(f"compile counts OK (abort churn): {eng.compile_counts()}")
+
 
 if __name__ == "__main__":
     _self_check()
