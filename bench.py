@@ -184,6 +184,27 @@ SERVE_HTTP_CONFIGS = {
                              block_size=8),
 }
 
+# Chaos leg (llm_np_cp_tpu/serve/faults.py + the EngineRunner
+# supervisor): the SAME Poisson trace replayed twice over HTTP — clean,
+# then under a seeded fault schedule (a tick-thread crash mid-flight and
+# a paged-kernel dispatch fault, plus transient 429s on the smoke) with
+# supervised restarts on.  The observables are what an outage costs:
+# recovery latency, p99 TTFT degradation vs the clean leg, and
+# token-identical recovery (the teacher-forced replay contract).  The
+# clean leg doubles as the "chaos off = unchanged numbers" reference.
+SERVE_CHAOS_CONFIGS = {
+    "serve_chaos_poisson": dict(model="llama1b", requests=32, rate=16.0,
+                                prompt_len=512, max_tokens=64, slots=8,
+                                block_size=128,
+                                chaos="tick_crash@90;decode@40",
+                                tick_deadline=60.0, backoff=0.2),
+    "smoke_serve_chaos": dict(model="tiny", requests=8, rate=50.0,
+                              prompt_len=16, max_tokens=6, slots=2,
+                              block_size=8,
+                              chaos="tick_crash@8;decode@4;http_429@2:2=0",
+                              tick_deadline=30.0, backoff=0.05),
+}
+
 SPEC_CONFIGS = {
     # batched self-speculation: bf16 target + int8 self-draft, γ=4
     "int8_spec_bs8": dict(model="llama1b", batch=8, prompt_len=128,
@@ -220,6 +241,7 @@ PRIORITY = [
     "serve_poisson_bs8",  # continuous-batching serving engine (serve/)
     "serve_prefix_shared",  # prefix-cache reuse + gather-vs-paged decode
     "serve_http_poisson",  # HTTP front-end overhead vs direct engine calls
+    "serve_chaos_poisson",  # supervised recovery under a seeded fault schedule
     "gemma2_2b_bs8",      # Gemma north-star number (VERDICT task 3)
     "int8_bs8",           # roofline-gap anchor (VERDICT task 6)
     "int8a8_bs8",         # W8A8 int8-MXU einsums vs that anchor
@@ -249,7 +271,7 @@ assert set(PRIORITY) == {
     n
     for n in list(DECODE_CONFIGS) + list(SPEC_CONFIGS)
     + list(PREFILL_CONFIGS) + list(RAGGED_CONFIGS) + list(SERVE_CONFIGS)
-    + list(SERVE_HTTP_CONFIGS)
+    + list(SERVE_HTTP_CONFIGS) + list(SERVE_CHAOS_CONFIGS)
     if not n.startswith("smoke")
 } | EXTRA_CHILDREN, "PRIORITY out of sync with config dicts"
 
@@ -270,6 +292,10 @@ TIMEOUTS = {
     # arrival pacing (~2s traffic span each) on top of the serve compile
     # budget; the HTTP leg adds event-loop + SSE framing time per token
     "serve_http_poisson": 850,
+    # clean + chaos HTTP legs at realtime pacing, plus a supervised
+    # restart (backoff + pool rebuild + teacher-forced replay prefills)
+    # inside the chaos leg's measured span
+    "serve_chaos_poisson": 850,
     # prefill-dominated: the marginal measurement's extra prefill+half
     # decode per rep nearly doubles measured-phase wall time
     "llama3b_seq2048_bs8": 700,
@@ -800,6 +826,73 @@ def run_serve_config(name: str) -> dict:
     }
 
 
+def _client_pct(vals: list, q: float) -> float:
+    """Client-observed-TTFT percentile — the SAME estimator as
+    ServeMetrics._pcts (np.percentile linear interpolation), shared by
+    the HTTP and chaos legs: a different one would fold estimator
+    mismatch into the deltas those configs exist to measure."""
+    import numpy as np
+
+    return float(np.percentile(vals, q)) if vals else float("nan")
+
+
+def _run_http_trace_leg(
+    engine, model_id: str, trace: list, *, client_timeout: float,
+    retries: int = 3, backoff_s: float = 0.25, scrape: bool = False,
+    server_kwargs: dict | None = None,
+) -> tuple[list, dict, str | None]:
+    """One realtime HTTP replay of ``trace``: in-process HttpServer, one
+    SSE client per request sleeping until its arrival time (with
+    transient 429/503 retry — a queue blip must not burn the leg, and a
+    retried request's TTFT honestly carries the added wait), an optional
+    Prometheus scrape before drain, and the runner's supervision stats.
+    The ONE leg runner shared by the HTTP-overhead and chaos configs so
+    their client machinery cannot drift."""
+    import asyncio
+
+    from llm_np_cp_tpu.serve.http.client import astream_completion, http_get
+    from llm_np_cp_tpu.serve.http.server import HttpServer
+
+    async def leg():
+        server = HttpServer(engine, model_id=model_id, drain_timeout=60.0,
+                            **(server_kwargs or {}))
+        await server.start("127.0.0.1", 0)
+
+        async def one(item):
+            await asyncio.sleep(item["arrival_s"])
+            return await astream_completion(
+                server.host, server.port,
+                {"model": model_id,
+                 "prompt": [int(t) for t in item["prompt"]],
+                 "max_tokens": item["max_new_tokens"],
+                 "seed": item.get("seed", 0)},
+                timeout=client_timeout, retries=retries,
+                backoff_s=backoff_s,
+            )
+
+        results = await asyncio.gather(*(one(item) for item in trace))
+        prom = None
+        if scrape:
+            loop = asyncio.get_running_loop()
+            _, raw = await loop.run_in_executor(
+                None, http_get, server.host, server.port, "/metrics")
+            prom = raw.decode()
+        runner = server.runner
+        stats = {
+            "restarts": runner.restarts,
+            "recovery_latency_s": [
+                round(v, 4) for v in runner.recovery_latency_s
+            ],
+            "decode_impl_final": runner.engine.decode_attn_impl,
+            "compile_counts": runner.engine.compile_counts(),
+        }
+        server.begin_drain()
+        await server.serve_until_shutdown()
+        return list(results), stats, prom
+
+    return asyncio.run(leg())
+
+
 def run_serve_http_config(name: str) -> dict:
     """HTTP front-end overhead: ONE engine, the SAME Poisson trace, two
     realtime replays — direct ``ServeEngine`` calls, then the in-process
@@ -809,16 +902,12 @@ def run_serve_http_config(name: str) -> dict:
     measured, not guessed.  The HTTP leg's TTFT is CLIENT-observed
     (request sent → first SSE chunk parsed), which is what a user sees.
     """
-    import asyncio
-
     import jax.numpy as jnp
     import numpy as np
 
     from llm_np_cp_tpu.ops.sampling import Sampler
     from llm_np_cp_tpu.serve import ServeEngine, ServeMetrics, poisson_trace
     from llm_np_cp_tpu.serve.engine import pool_geometry
-    from llm_np_cp_tpu.serve.http.client import astream_completion, http_get
-    from llm_np_cp_tpu.serve.http.server import HttpServer
 
     t0 = time.perf_counter()
     spec = SERVE_HTTP_CONFIGS[name]
@@ -865,34 +954,11 @@ def run_serve_http_config(name: str) -> dict:
     # request sleeping until its arrival time
     engine.metrics = ServeMetrics(clock=engine.clock)
     engine.scheduler.finished.clear()
-
-    async def http_leg() -> tuple[list[dict], str]:
-        server = HttpServer(engine, model_id=spec["model"],
-                            drain_timeout=30.0)
-        await server.start("127.0.0.1", 0)
-
-        async def one(item, idx):
-            await asyncio.sleep(item["arrival_s"])
-            return await astream_completion(
-                server.host, server.port,
-                {"model": spec["model"],
-                 "prompt": [int(t) for t in item["prompt"]],
-                 "max_tokens": item["max_new_tokens"],
-                 "seed": item.get("seed", 0)},
-                timeout=TIMEOUTS.get(name, DEFAULT_TIMEOUT) / 2,
-            )
-
-        results = await asyncio.gather(
-            *(one(item, i) for i, item in enumerate(trace))
-        )
-        loop = asyncio.get_running_loop()
-        _, prom = await loop.run_in_executor(
-            None, http_get, server.host, server.port, "/metrics")
-        server.begin_drain()
-        await server.serve_until_shutdown()
-        return list(results), prom.decode()
-
-    results, prom = asyncio.run(http_leg())
+    results, _http_stats, prom = _run_http_trace_leg(
+        engine, spec["model"], trace,
+        client_timeout=TIMEOUTS.get(name, DEFAULT_TIMEOUT) / 2,
+        scrape=True,
+    )
     _phase(name, "http_done", t0)
 
     http_ok = [r for r in results if r["status"] == 200]
@@ -902,13 +968,7 @@ def run_serve_http_config(name: str) -> dict:
     ) if len(http_ok) == len(direct_tokens) else False
     ttft_http = [r["ttft_s"] for r in http_ok if r["ttft_s"]]
     http_snap = engine.metrics.snapshot()
-
-    def pct(vals: list, q: float) -> float:
-        # SAME estimator as ServeMetrics._pcts (np.percentile linear
-        # interpolation) — a different one here would fold estimator
-        # mismatch into the overhead delta this config exists to measure
-        return float(np.percentile(vals, q)) if vals else float("nan")
-
+    pct = _client_pct
     d_p50 = direct.get("ttft_s_p50", float("nan"))
     d_p99 = direct.get("ttft_s_p99", float("nan"))
     h_p50, h_p99 = pct(ttft_http, 50), pct(ttft_http, 99)
@@ -933,6 +993,131 @@ def run_serve_http_config(name: str) -> dict:
         "throughput_tok_s_http": round(http_snap["throughput_tok_s"], 1),
         "metrics_scrape_ok": "llm_serve_requests_finished_total" in prom,
         "compile_counts": engine.compile_counts(),
+    }
+
+
+def run_serve_chaos_config(name: str) -> dict:
+    """Supervised recovery under fault injection: the SAME Poisson trace
+    through the HTTP server twice — a clean leg, then a chaos leg with a
+    seeded fault schedule (tick-thread crash + paged dispatch fault) and
+    ``max_restarts=3`` supervision.  Reports recovery latency, restart
+    count, p99 TTFT degradation vs clean, and token parity (recovered
+    streams must be token-identical — the teacher-forced replay
+    contract).  The clean leg is also the "chaos disabled = unchanged
+    numbers" reference for the injection points' zero-overhead claim."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from llm_np_cp_tpu.ops.pallas.support import (
+        kernel_error,
+        paged_kernel_name,
+    )
+    from llm_np_cp_tpu.ops.sampling import Sampler
+    from llm_np_cp_tpu.serve import FaultInjector, ServeEngine, poisson_trace
+    from llm_np_cp_tpu.serve.engine import pool_geometry
+
+    t0 = time.perf_counter()
+    spec = SERVE_CHAOS_CONFIGS[name]
+    config, params = _build_model(spec["model"], tag=name, t0=t0)
+    _phase(name, "params_built", t0)
+
+    bs = spec["block_size"]
+    chunk = min(bs * 2, 256)
+    _, num_blocks, max_seq_len = pool_geometry(
+        spec["prompt_len"], spec["max_tokens"], spec["slots"], bs,
+        prefill_chunk=chunk,
+    )
+    # paged when the probe passes: the chaos 'decode' fault then
+    # exercises the runtime gather fallback; on gather it exercises a
+    # second supervised restart instead — both are recovery paths
+    impl = "paged" if kernel_error(paged_kernel_name(False)) is None \
+        else "xla"
+    rng = np.random.default_rng(13)
+    trace = poisson_trace(
+        rng, spec["requests"], rate_rps=spec["rate"],
+        prompt_len_range=(max(spec["prompt_len"] // 4, 1),
+                          spec["prompt_len"]),
+        max_new_tokens=spec["max_tokens"], vocab_size=config.vocab_size,
+        seed_base=13,
+    )
+    _phase(name, "trace_built", t0)
+
+    def build_engine(injector):
+        engine = ServeEngine(
+            params, config,
+            sampler=Sampler(kind="greedy"),
+            max_slots=spec["slots"],
+            num_blocks=num_blocks,
+            block_size=bs,
+            max_seq_len=max_seq_len,
+            prefill_chunk=chunk,
+            cache_dtype=jnp.bfloat16,
+            decode_attn_impl=impl,
+            fault_injector=injector,
+        )
+        engine.warmup([int(t["prompt"].size) for t in trace],
+                      max_new_tokens=spec["max_tokens"])
+        return engine
+
+    def run_leg(engine, tag):
+        results, stats, _ = _run_http_trace_leg(
+            engine, spec["model"], trace,
+            client_timeout=TIMEOUTS.get(name, DEFAULT_TIMEOUT) / 3,
+            retries=4, backoff_s=0.1,
+            server_kwargs=dict(
+                tick_deadline=spec.get("tick_deadline"),
+                max_restarts=3,
+                restart_backoff_s=spec.get("backoff", 0.2),
+            ),
+        )
+        _phase(name, f"{tag}_done", t0, restarts=stats["restarts"])
+        ok = [r for r in results if r["status"] == 200]
+        ttft = [r["ttft_s"] for r in ok if r["ttft_s"]]
+        return results, ok, ttft, stats
+
+    clean_results, clean_ok, clean_ttft, clean_stats = run_leg(
+        build_engine(None), "clean")
+    clean_tokens = [r["token_ids"] for r in clean_results]
+
+    injector = FaultInjector(spec["chaos"], seed=13)
+    chaos_results, chaos_ok, chaos_ttft, chaos_stats = run_leg(
+        build_engine(injector), "chaos")
+    parity = [r["token_ids"] for r in chaos_results] == clean_tokens
+
+    c50, c99 = _client_pct(clean_ttft, 50), _client_pct(clean_ttft, 99)
+    x50, x99 = _client_pct(chaos_ttft, 50), _client_pct(chaos_ttft, 99)
+    recov = chaos_stats["recovery_latency_s"]
+    return {
+        "config": name,
+        "ok": (len(clean_ok) == spec["requests"]
+               and len(chaos_ok) == spec["requests"]
+               and parity and chaos_stats["restarts"] >= 1),
+        "requests": spec["requests"],
+        "rate_rps": spec["rate"],
+        "slots": spec["slots"],
+        "pool_blocks": num_blocks,
+        "block_size": bs,
+        "attn_impl": impl,
+        "chaos_spec": spec["chaos"],
+        # every request completed despite the schedule, token-identically
+        "token_parity_chaos_vs_clean": parity,
+        "restarts": chaos_stats["restarts"],
+        "faults_injected": injector.snapshot(),
+        "client_retries_total": sum(
+            r.get("retries", 0) for r in chaos_results
+        ),
+        # the headline pair: what an engine death costs
+        "recovery_latency_s": recov,
+        "recovery_latency_s_max": max(recov) if recov else None,
+        "ttft_s_p50_clean": round(c50, 4),
+        "ttft_s_p99_clean": round(c99, 4),
+        "ttft_s_p50_chaos": round(x50, 4),
+        "ttft_s_p99_chaos": round(x99, 4),
+        "chaos_ttft_p99_degradation_s": round(x99 - c99, 4),
+        "decode_impl_final": chaos_stats["decode_impl_final"],
+        # restart must not recompile: decode stays at its one program
+        "compile_counts": chaos_stats["compile_counts"],
+        "compile_counts_clean": clean_stats["compile_counts"],
     }
 
 
@@ -1034,7 +1219,7 @@ def run_warm() -> dict:
         n for n in PRIORITY
         if n not in SPEC_CONFIGS and n not in EXTRA_CHILDREN
         and n not in RAGGED_CONFIGS and n not in SERVE_CONFIGS
-        and n not in SERVE_HTTP_CONFIGS
+        and n not in SERVE_HTTP_CONFIGS and n not in SERVE_CHAOS_CONFIGS
     ]
     for name in warmable[:warm_limit]:
         spec = {**DECODE_CONFIGS, **PREFILL_CONFIGS}[name]
@@ -1375,6 +1560,8 @@ def child_main(mode: str) -> None:
         out = run_serve_config(mode)
     elif mode in SERVE_HTTP_CONFIGS:
         out = run_serve_http_config(mode)
+    elif mode in SERVE_CHAOS_CONFIGS:
+        out = run_serve_chaos_config(mode)
     else:
         raise SystemExit(f"unknown config {mode!r}")
     print(json.dumps(out), flush=True)
@@ -1635,6 +1822,7 @@ def main() -> None:
         spec_env = {
             **DECODE_CONFIGS, **PREFILL_CONFIGS, **SPEC_CONFIGS,
             **RAGGED_CONFIGS, **SERVE_CONFIGS, **SERVE_HTTP_CONFIGS,
+            **SERVE_CHAOS_CONFIGS,
         }.get(name, {}).get("env")
         res = _spawn(name, budget, env=spec_env)
         detail[name] = res

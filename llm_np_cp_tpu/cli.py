@@ -154,6 +154,17 @@ def _add_serve_engine_flags(p: argparse.ArgumentParser,
     p.add_argument("--sampler", choices=["greedy", "min_p", "top_k", "top_p",
                                          "cdf"], default="greedy")
     p.add_argument("--dtype", choices=["bf16", "f32"], default="bf16")
+    p.add_argument("--chaos-spec", default=None, metavar="SPEC",
+                   help="fault-injection schedule (serve/faults.py): "
+                   "events 'site@N[:COUNT][=ARG]' (deterministic) or "
+                   "'site%%P[=ARG]' (seeded probability) joined by ';' — "
+                   "sites: decode, prefill, tick_crash, tick_hang, "
+                   "ckpt_read, http_429, http_reset.  Default: the "
+                   "LLMTPU_CHAOS_SPEC env var, else chaos off (injection "
+                   "points are zero-overhead no-ops)")
+    p.add_argument("--chaos-seed", type=int, default=0,
+                   help="seed for probabilistic chaos events (a fixed "
+                   "seed replays the identical fault schedule)")
 
 
 def build_serve_parser(default_model: str) -> argparse.ArgumentParser:
@@ -208,6 +219,24 @@ def build_http_serve_parser(default_model: str) -> argparse.ArgumentParser:
     p.add_argument("--drain-timeout", type=float, default=30.0, metavar="S",
                    help="SIGTERM drain: wait this long for in-flight "
                    "requests before aborting stragglers")
+    p.add_argument("--tick-deadline", type=float, default=0.0, metavar="S",
+                   help="watchdog: declare the engine HUNG when no tick "
+                   "heartbeat lands within S seconds and hand it to the "
+                   "supervisor (0 = no watchdog; crashes are still "
+                   "supervised)")
+    p.add_argument("--max-restarts", type=int, default=3,
+                   help="supervised restart INTENSITY budget: engine "
+                   "deaths within a --restart-window span (bounded "
+                   "exponential backoff; in-flight requests are replayed "
+                   "token-identically) before the server goes terminally "
+                   "503.  Isolated, fully-recovered blips outside the "
+                   "window do not consume the budget.  0 restores "
+                   "crash-equals-outage behavior")
+    p.add_argument("--restart-window", type=float, default=300.0,
+                   metavar="S",
+                   help="the sliding window (seconds) --max-restarts "
+                   "counts engine deaths in; a crash LOOP exhausts the "
+                   "budget, a blip a day does not")
     p.add_argument("--port-file", default=None, metavar="PATH",
                    help="write 'host port' to PATH once listening "
                    "(readiness for scripts and tests)")
@@ -225,8 +254,33 @@ def _validate_pool_flags(args) -> None:
         )
 
 
+def _chaos_injector(args):
+    """Resolve --chaos-spec (or LLMTPU_CHAOS_SPEC) into a FaultInjector —
+    or None, the zero-overhead default.  Called BEFORE the model load so
+    the ckpt_read site covers checkpoint IO, and installed globally for
+    the engine-less injection points.  Malformed specs fail here, before
+    any multi-minute load."""
+    import os
+
+    from llm_np_cp_tpu.serve.faults import FaultInjector, install
+
+    spec = args.chaos_spec
+    if spec is None:
+        spec = os.environ.get("LLMTPU_CHAOS_SPEC", "")
+    try:
+        injector = FaultInjector.from_spec(spec, seed=args.chaos_seed)
+    except ValueError as e:
+        raise SystemExit(f"--chaos-spec: {e}") from None
+    if injector is not None:
+        install(injector)
+        print(f"[chaos] fault injection ACTIVE: {spec!r} "
+              f"(seed {args.chaos_seed})")
+    return injector
+
+
 def _build_serve_engine(args, params, config, *, prog: str,
-                        tokenizer=None, max_queue: int | None = None):
+                        tokenizer=None, max_queue: int | None = None,
+                        fault_injector=None):
     """The shared engine build for both serve subcommands: validate the
     pool flags, resolve --attn-impl against the Mosaic probe (an EXPLICIT
     paged request must fail with an actionable message when the kernel
@@ -288,6 +342,7 @@ def _build_serve_engine(args, params, config, *, prog: str,
         enable_prefix_cache=args.prefix_cache,
         max_queue=max_queue,
         tokenizer=tokenizer,
+        fault_injector=fault_injector,
     )
     return engine, num_blocks
 
@@ -304,9 +359,10 @@ def _run_serve_bench(argv: list[str], default_model: str) -> str:
             f"--distinct-prompts must be >= 0 (0 = every prompt distinct), "
             f"got {args.distinct_prompts}"
         )
+    injector = _chaos_injector(args)
     _tok, params, config = _load(args)
     engine, num_blocks = _build_serve_engine(
-        args, params, config, prog="serve-bench",
+        args, params, config, prog="serve-bench", fault_injector=injector,
     )
     rng = np.random.default_rng(args.seed)
     trace = poisson_trace(
@@ -344,10 +400,19 @@ def _run_http_serve(argv: list[str], default_model: str) -> str:
         raise SystemExit(
             f"--request-timeout must be >= 0, got {args.request_timeout}"
         )
+    if args.tick_deadline < 0:
+        raise SystemExit(
+            f"--tick-deadline must be >= 0, got {args.tick_deadline}"
+        )
+    if args.max_restarts < 0:
+        raise SystemExit(
+            f"--max-restarts must be >= 0, got {args.max_restarts}"
+        )
+    injector = _chaos_injector(args)
     tok, params, config = _load(args)
     engine, num_blocks = _build_serve_engine(
         args, params, config, prog="serve", tokenizer=tok,
-        max_queue=args.max_queue or None,
+        max_queue=args.max_queue or None, fault_injector=injector,
     )
     # warm the phase programs BEFORE accepting traffic: the first real
     # request must not pay a multi-second model compile in its TTFT
@@ -357,7 +422,8 @@ def _run_http_serve(argv: list[str], default_model: str) -> str:
         f"pool={num_blocks}x{args.block_size} ({args.cache_dtype}), "
         f"attn={engine.decode_attn_impl}, "
         f"prefix_cache={'on' if args.prefix_cache else 'off'}, "
-        f"max_queue={args.max_queue or 'unbounded'}"
+        f"max_queue={args.max_queue or 'unbounded'}, "
+        f"supervision={'off' if not args.max_restarts else f'{args.max_restarts} restarts'}"
     )
     print(banner)
 
@@ -375,6 +441,9 @@ def _run_http_serve(argv: list[str], default_model: str) -> str:
         drain_timeout=args.drain_timeout,
         default_max_tokens=args.max_tokens,
         max_tokens_cap=args.max_tokens,
+        tick_deadline=args.tick_deadline or None,
+        max_restarts=args.max_restarts,
+        restart_window_s=args.restart_window,
         port_file=args.port_file,
         exit_after_s=args.exit_after_s,
         on_started=on_started,

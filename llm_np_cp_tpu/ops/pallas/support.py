@@ -28,6 +28,22 @@ log = logging.getLogger("llm_np_cp_tpu")
 # test hook: force every probe to report failure (monkeypatched in tests)
 _FORCE_FAIL = False
 
+# Runtime degradation ledger: kernels that PASSED their startup probe but
+# then faulted at dispatch mid-traffic (serve engine runtime fallback).
+# A faulted kernel stays disabled for the whole process — including
+# supervisor engine rebuilds — so one bad dispatch becomes one fallback,
+# not a crash loop.  kernel name → reason string.
+_RUNTIME_DISABLED: dict[str, str] = {}
+
+
+def disable_kernel(kernel: str, reason: str) -> None:
+    """Record a dispatch-time fault for ``kernel``: every subsequent
+    ``kernel_error``/``gate_attn_impl`` call reports it unavailable."""
+    _RUNTIME_DISABLED.setdefault(kernel, f"faulted at dispatch: {reason}")
+    log.warning(
+        "Pallas kernel %s disabled for this process (%s)", kernel, reason
+    )
+
 
 @functools.lru_cache(maxsize=None)
 def _probe(kernel: str, backend: str) -> str | None:
@@ -123,7 +139,11 @@ def paged_kernel_name(int8_cache: bool) -> str:
 
 
 def kernel_error(kernel: str) -> str | None:
-    """None if `kernel` compiles on the current default backend."""
+    """None if `kernel` compiles on the current default backend and has
+    not been disabled by a dispatch-time fault (``disable_kernel``)."""
+    disabled = _RUNTIME_DISABLED.get(kernel)
+    if disabled is not None:
+        return disabled
     return _probe(kernel, jax.default_backend())
 
 

@@ -22,6 +22,11 @@ Modules:
 - ``prefix_cache`` — refcounted prompt-prefix block sharing: chained
   content hashes → pool block ids, claimed at admission so matching
   prefill chunks are skipped entirely.
+- ``faults``      — deterministic, seeded fault injection
+  (``FaultInjector``): chaos specs schedule decode/prefill faults, hung
+  or crashed ticks, transient checkpoint IO errors, and HTTP
+  resets/429s through injection points threaded across the stack;
+  no-op (one is-None check) by default.
 - ``metrics``     — queue depth, TTFT, per-request decode tok/s, pool
   occupancy, preemptions, aborts/rejects, prefix hit-rate, K/V bytes per
   tick; exported as a dict and as Prometheus text (thread-safe
@@ -34,6 +39,7 @@ Modules:
 """
 
 from llm_np_cp_tpu.serve.block_pool import BlockPool, FreeList
+from llm_np_cp_tpu.serve.faults import FaultInjected, FaultInjector
 from llm_np_cp_tpu.serve.engine import (
     ServeEngine,
     pool_geometry,
@@ -51,6 +57,8 @@ from llm_np_cp_tpu.serve.trace import poisson_trace
 
 __all__ = [
     "BlockPool",
+    "FaultInjected",
+    "FaultInjector",
     "FreeList",
     "PrefixCache",
     "QueueFull",

@@ -72,6 +72,7 @@ from llm_np_cp_tpu.ops.activations import ACT2FN
 from llm_np_cp_tpu.ops.rope import rope_cos_sin
 from llm_np_cp_tpu.ops.sampling import Sampler
 from llm_np_cp_tpu.serve.block_pool import BlockPool, PagedKV
+from llm_np_cp_tpu.serve.faults import FaultInjected, FaultInjector
 from llm_np_cp_tpu.serve.metrics import ServeMetrics
 from llm_np_cp_tpu.serve.prefix_cache import prefix_block_keys
 from llm_np_cp_tpu.serve.scheduler import (
@@ -154,6 +155,7 @@ class ServeEngine:
         max_queue: int | None = None,
         tokenizer: Any = None,
         clock: Callable[[], float] = time.perf_counter,
+        fault_injector: FaultInjector | None = None,
     ) -> None:
         if decode_attn_impl not in ("xla", "flash_decode", "paged"):
             raise ValueError(
@@ -166,6 +168,12 @@ class ServeEngine:
             decode_attn_impl, int8_cache=jnp.dtype(cache_dtype) == jnp.int8
         )
         self.decode_attn_impl = decode_attn_impl  # post-gate (tests/CLI)
+        # seeded chaos schedule (serve/faults.py); None = every injection
+        # point is a single is-None check (zero overhead)
+        self.faults = fault_injector
+        # reason string once the paged decode step faulted at dispatch
+        # and the engine fell back to the gather impl (None = healthy)
+        self.decode_degraded: str | None = None
         self.params = params
         self.config = config
         self.sampler = sampler or Sampler(kind="greedy")
@@ -594,6 +602,7 @@ class ServeEngine:
         on_event: Callable[[Request, str], None] | None = None,
         deadline_s: float | None = None,
         arrival_time: float | None = None,
+        _recovered: bool = False,
     ) -> Request:
         prompt = np.asarray(prompt_ids, dtype=np.int32).reshape(-1)
         if prompt.size == 0:
@@ -644,17 +653,148 @@ class ServeEngine:
         if deadline_s is not None:
             req.deadline = req.submit_time + deadline_s
         try:
-            self.scheduler.add(req)
+            # supervisor replays of already-admitted work are exempt from
+            # the queue cap, like preemption requeues — the cap must not
+            # orphan a request the engine had already accepted
+            self.scheduler.add(req, exempt_cap=_recovered)
         except QueueFull:
             # backpressure, not a client error: count the reject so the
             # 429s the HTTP layer returns are visible in /metrics
             self.metrics.on_reject()
             raise
-        self.metrics.on_submit(req)
+        if _recovered:
+            # counted at its ORIGINAL submit (the metrics object survives
+            # the restart); record the recovery itself instead
+            self.metrics.on_recover()
+        else:
+            self.metrics.on_submit(req)
         self._requests[req.req_id] = req
         if self.tokenizer is not None:
             self._detok[req.req_id] = IncrementalDetok(self.tokenizer)
         return req
+
+    def recover(
+        self,
+        prompt_ids: np.ndarray | list[int],
+        max_new_tokens: int,
+        *,
+        request_id: int,
+        seed: int = 0,
+        generated: list[int] | tuple[int, ...] = (),
+        callback: Callable[[Request, int, str | None], None] | None = None,
+        on_event: Callable[[Request, str], None] | None = None,
+        deadline_s: float | None = None,
+    ) -> Request:
+        """Resubmit a request that was in flight when a previous engine
+        instance died, with its already-delivered tokens teacher-forced.
+
+        This is the evict-requeue discipline applied across an engine
+        rebuild: ``generated`` pre-seeds the request, so its first
+        prefill runs over prompt+generated (``effective_prompt``) and the
+        decode RNG keys derive from (seed, content position) — the
+        continuation is token-identical to an uninterrupted run, and the
+        pre-seeded tokens are NOT re-emitted through the callback.
+        ``deadline_s`` restarts relative to now (a recovered request gets
+        its full window back rather than being instantly swept).  The
+        caller filters requests that were already terminal (``generated``
+        at budget, or ending in a stop token) — those need only their
+        lost finish event, not a resubmit.
+        """
+        if len(generated) >= max_new_tokens:
+            raise ValueError(
+                f"request {request_id} already generated "
+                f"{len(generated)}/{max_new_tokens} tokens; deliver its "
+                "finish event instead of recovering it"
+            )
+        req = self.submit(
+            prompt_ids, max_new_tokens, request_id=request_id, seed=seed,
+            callback=callback, on_event=on_event, deadline_s=deadline_s,
+            _recovered=True,
+        )
+        req.generated = [int(t) for t in generated]
+        detok = self._detok.get(req.req_id)
+        if detok is not None:
+            # advance the detokenizer over the replayed tokens so the
+            # next delta continues the client's text exactly; the deltas
+            # themselves were already delivered pre-crash
+            for tok in req.generated:
+                detok.push(tok)
+        return req
+
+    def finish_recovered(
+        self,
+        prompt_ids: np.ndarray | list[int],
+        max_new_tokens: int,
+        *,
+        request_id: int,
+        generated: list[int] | tuple[int, ...],
+        reason: str,
+    ) -> str | None:
+        """Terminal bookkeeping for a request that was recovered ALREADY
+        complete (every token generated pre-crash; only its finish event
+        was lost) or that recovery had to drop: counts the finish/abort
+        in metrics — which survive the rebuild, so submitted must keep
+        balancing finished+aborted+live — without re-running anything.
+        Returns the detokenizer's held-back tail text (a fresh detok
+        replayed over the tokens yields the same delta sequence the
+        original emitted, so what its flush holds is exactly what the
+        lost finish event would have carried) for the caller to deliver.
+        The companion to ``recover`` for the supervisor's replay path."""
+        req = Request(
+            req_id=request_id,
+            prompt=np.asarray(prompt_ids, dtype=np.int32).reshape(-1),
+            max_new_tokens=max_new_tokens,
+        )
+        req.generated = [int(t) for t in generated]
+        req.finish_reason = reason
+        if reason == "aborted":
+            self.metrics.on_abort(req)
+        else:
+            self.metrics.on_finish(req)
+        if self.tokenizer is None or not req.generated:
+            return None
+        detok = IncrementalDetok(self.tokenizer)
+        for tok in req.generated:
+            detok.push(tok)
+        return detok.flush() or None
+
+    def clone_fresh(self) -> "ServeEngine":
+        """A fresh engine with the same params/config/geometry and a
+        zeroed block pool — what a supervisor restart rebuilds after a
+        crash.  The compiled step programs are SHARED with this engine
+        (identical geometry → identical jaxprs), so a restart never
+        re-traces or recompiles (pinned by tools/compile_counter.py), and
+        the metrics object carries over so operator counters survive."""
+        eng = ServeEngine(
+            self.params, self.config,
+            sampler=self.sampler,
+            stop_tokens=self.stop_tokens,
+            max_slots=self.scheduler.max_slots,
+            num_blocks=self.pool.num_blocks,
+            block_size=self.block_size,
+            max_seq_len=self.max_seq_len,
+            prefill_chunk=self.prefill_chunk,
+            cache_dtype=self.cache_dtype,
+            decode_attn_impl=self.decode_attn_impl,
+            enable_prefix_cache=self.pool.prefix_cache is not None,
+            max_queue=self.scheduler.max_queue,
+            tokenizer=self.tokenizer,
+            clock=self.clock,
+            fault_injector=self.faults,
+        )
+        eng.metrics = self.metrics
+        eng.decode_degraded = self.decode_degraded
+        eng._next_id = self._next_id
+        names = ["_prefill_step", "_sample_first", "_scatter_prefill",
+                 "_gather_prefix"]
+        if eng.decode_attn_impl == self.decode_attn_impl:
+            # the gate can downgrade the clone (e.g. the paged kernel was
+            # runtime-disabled between builds) — share the decode step
+            # only when both engines resolved to the same impl
+            names.append("_decode_step")
+        for name in names:
+            setattr(eng, name, getattr(self, name))
+        return eng
 
     def _emit(self, req: Request, token: int) -> None:
         req.generated.append(int(token))
@@ -754,6 +894,8 @@ class ServeEngine:
         on its token and position) and the remaining chunks run from that
         offset.  Only the fresh blocks are scattered back; shared blocks
         are never written."""
+        if self.faults is not None and self.faults.trip("prefill") is not None:
+            raise FaultInjected("prefill")
         content = req.effective_prompt()
         w = self._prefill_width(req)
         req.pad = w - content.size
@@ -838,8 +980,7 @@ class ServeEngine:
                 pads[r.slot] = r.pad
                 toks[r.slot] = r.generated[-1]
                 seeds[r.slot] = np.uint32(r.seed)
-            nxt, self.pool.pages = self._decode_step(
-                self.params, self.pool.pages,
+            nxt, self.pool.pages = self._dispatch_decode(
                 jnp.asarray(tables), jnp.asarray(lengths), jnp.asarray(pads),
                 jnp.asarray(toks), jnp.asarray(seeds),
             )
@@ -856,6 +997,54 @@ class ServeEngine:
             kv_bytes=self._kv_bytes_tick(running) if running else 0,
         )
         return self.scheduler.has_work
+
+    def _dispatch_decode(self, *args: jnp.ndarray) -> tuple:
+        """One decode dispatch with runtime kernel degradation: if the
+        paged step faults at dispatch time (an injected chaos fault or a
+        real Mosaic/runtime error that the startup probe could not
+        foresee), permanently fall back to the gather impl for the whole
+        process and retry the SAME tick on it — requests see one slower
+        tick, never a failure.  On the gather impls there is nothing left
+        to degrade to, so faults propagate (the supervisor's problem)."""
+        faults = self.faults
+        if (
+            faults is not None
+            and faults.trip("decode") is not None
+            and not self._degrade_decode("chaos: injected decode-dispatch "
+                                         "fault")
+        ):
+            raise FaultInjected("decode")
+        try:
+            return self._decode_step(self.params, self.pool.pages, *args)
+        except Exception as e:  # noqa: BLE001 — any dispatch fault gates
+            if not self._degrade_decode(f"{type(e).__name__}: {e}"):
+                raise
+            # the paged step donated the pool pages; if the fault struck
+            # after they were consumed this retry raises on the deleted
+            # buffers and the supervisor restart (which rebuilds the
+            # pool) takes over — injected faults fire before dispatch,
+            # so the chaos path always retries cleanly
+            return self._decode_step(self.params, self.pool.pages, *args)
+
+    def _degrade_decode(self, reason: str) -> bool:
+        """Paged → gather runtime fallback.  Returns False when there is
+        no fallback (already on a gather impl)."""
+        if self.decode_attn_impl != "paged":
+            return False
+        from llm_np_cp_tpu.ops.pallas.support import (
+            disable_kernel,
+            paged_kernel_name,
+        )
+
+        # process-wide: a supervisor rebuild (clone_fresh) and any future
+        # engine in this process must not re-select the faulted kernel
+        disable_kernel(
+            paged_kernel_name(self.cache_dtype == jnp.int8), reason
+        )
+        self.decode_degraded = reason
+        self.decode_attn_impl = "xla"
+        self._decode_step = self._make_decode_step("xla")
+        return True
 
     def _kv_bytes_tick(self, running: list[Request]) -> int:
         """K/V bytes this tick's decode attention touches — the
@@ -905,6 +1094,18 @@ class ServeEngine:
         harmless by construction)."""
         if not prompt_lens:
             return
+        # chaos is suspended for the warmup pass: it is compile-only, so
+        # its dispatches must not consume deterministic schedule hits
+        # (shifting every site's firing point) and a scheduled fault must
+        # not fire here, where no supervisor is watching yet
+        faults, self.faults = self.faults, None
+        try:
+            self._warmup_body(prompt_lens, max_new_tokens)
+        finally:
+            self.faults = faults
+
+    def _warmup_body(self, prompt_lens: list[int],
+                     max_new_tokens: int) -> None:
         # two decode tokens compile the decode/sample/column-scatter
         # programs; the workload's full budget only matters for b_max
         self.submit(np.ones(min(prompt_lens), np.int32),

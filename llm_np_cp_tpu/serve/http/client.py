@@ -15,6 +15,7 @@ import asyncio
 import contextlib
 import http.client
 import json
+import random
 import time
 from typing import Any
 
@@ -47,29 +48,15 @@ def post_completion(host: str, port: int, payload: dict[str, Any],
         conn.close()
 
 
-async def astream_completion(
-    host: str, port: int, payload: dict[str, Any], *,
-    timeout: float = 120.0,
-    disconnect_after: int | None = None,
+async def _astream_once(
+    host: str, port: int, body: bytes, t0: float,
+    out: dict[str, Any], *,
+    timeout: float, disconnect_after: int | None,
 ) -> dict[str, Any]:
-    """POST a streaming completion and consume its SSE stream.
-
-    Returns ``{"status", "token_ids", "text", "finish_reason",
-    "ttft_s", "latency_s", "error"}``.  ``disconnect_after=n`` closes
-    the socket after the n-th token chunk (the forced mid-stream
-    disconnect the abort tests drive); the result then carries
-    ``finish_reason="disconnected"``.
-    """
-    t0 = time.perf_counter()
-    req = dict(payload)
-    req["stream"] = True
-    body = json.dumps(req).encode()
+    """One streaming POST attempt (no retry).  ``out`` is caller-owned so
+    partial progress (tokens already received) survives a mid-stream
+    exception — the retry wrapper must see it to refuse a resend."""
     reader, writer = await asyncio.open_connection(host, port)
-    out: dict[str, Any] = {
-        "status": None, "token_ids": [], "text": "",
-        "finish_reason": None, "ttft_s": None, "latency_s": None,
-        "error": None,
-    }
     try:
         writer.write(
             b"POST /v1/completions HTTP/1.1\r\n"
@@ -82,13 +69,20 @@ async def astream_completion(
 
         async def consume() -> None:
             status_line = await reader.readline()
+            if not status_line:
+                # closed before any response byte — the same transient
+                # class as a refused connection, typed so the retry
+                # wrapper's except tuple catches it
+                raise asyncio.IncompleteReadError(b"", None)
             out["status"] = int(status_line.split()[1])
-            headers = b""
             while True:
                 line = await reader.readline()
                 if line in (b"\r\n", b"\n", b""):
                     break
-                headers += line
+                key, _, value = line.decode("latin-1").partition(":")
+                if key.strip().lower() == "retry-after":
+                    with contextlib.suppress(ValueError):
+                        out["retry_after_s"] = float(value.strip())
             if out["status"] != 200:
                 out["error"] = (await reader.read()).decode(errors="replace")
                 return
@@ -117,3 +111,80 @@ async def astream_completion(
             await writer.wait_closed()
     out["latency_s"] = time.perf_counter() - t0
     return out
+
+
+async def astream_completion(
+    host: str, port: int, payload: dict[str, Any], *,
+    timeout: float = 120.0,
+    disconnect_after: int | None = None,
+    retries: int = 0,
+    backoff_s: float = 0.25,
+    max_backoff_s: float = 4.0,
+    rng: random.Random | None = None,
+) -> dict[str, Any]:
+    """POST a streaming completion and consume its SSE stream.
+
+    Returns ``{"status", "token_ids", "text", "finish_reason",
+    "ttft_s", "latency_s", "error", "retries"}``.  ``disconnect_after=n``
+    closes the socket after the n-th token chunk (the forced mid-stream
+    disconnect the abort tests drive); the result then carries
+    ``finish_reason="disconnected"``.
+
+    ``retries``: transient failures — HTTP 429/503 (backpressure, drain,
+    a mid-restart blip) and connection errors that struck before any
+    token arrived — are retried up to this many times with capped
+    exponential backoff plus jitter, honoring the server's ``Retry-After``
+    when it is larger than the backoff.  A stream that already delivered
+    tokens is never retried (a blind resend would duplicate output);
+    TTFT is measured from the FIRST attempt, so retried requests
+    honestly carry their queueing delay.
+    """
+    t0 = time.perf_counter()
+    req = dict(payload)
+    req["stream"] = True
+    body = json.dumps(req).encode()
+    rng = rng or random
+    attempts = 0
+    while True:
+        out: dict[str, Any] = {
+            "status": None, "token_ids": [], "text": "",
+            "finish_reason": None, "ttft_s": None, "latency_s": None,
+            "error": None, "retry_after_s": None,
+        }
+        try:
+            await _astream_once(
+                host, port, body, t0, out,
+                timeout=timeout, disconnect_after=disconnect_after,
+            )
+            # a 200 whose SSE stream ended with neither a token nor a
+            # finish_reason is a truncated response (a reset can read as
+            # clean EOF on loopback) — transient, like a refused
+            # connection; a truncated stream that DID deliver tokens is
+            # returned as-is (resending would duplicate generation)
+            transient = out["status"] in (429, 503) or (
+                out["status"] == 200 and not out["token_ids"]
+                and out["finish_reason"] is None
+            )
+        except (OSError, asyncio.IncompleteReadError) as e:
+            if isinstance(e, TimeoutError):
+                # py>=3.11 spells asyncio.wait_for's timeout as
+                # builtins.TimeoutError, an OSError subclass — a timeout
+                # is the caller's budget, never a transient to retry
+                raise
+            if out["token_ids"] or attempts >= retries:
+                # tokens already streamed: a blind resend would generate
+                # the whole completion twice — surface the failure
+                raise
+            # transient regardless of how far the response got: a reset
+            # after the 200 status line but before the first token (a
+            # restart blip, an injected reset) retries like a refusal
+            out["error"] = f"{type(e).__name__}: {e}"
+            transient = True
+        if not transient or out["token_ids"] or attempts >= retries:
+            out["retries"] = attempts
+            return out
+        wait = min(backoff_s * (2 ** attempts), max_backoff_s)
+        if out.get("retry_after_s"):
+            wait = max(wait, out["retry_after_s"])
+        await asyncio.sleep(wait * (1.0 + 0.25 * rng.random()))
+        attempts += 1

@@ -22,13 +22,23 @@ Endpoints:
   Client disconnect mid-stream aborts the request (blocks decref back to
   the pool); ``timeout_s`` (or the server-wide ``--request-timeout``)
   becomes an engine deadline with the same abort path.
-- ``GET /healthz`` — liveness + draining state.
+- ``GET /healthz`` — liveness + supervision state (``ok`` /
+  ``degraded`` during a supervised engine restart / ``draining`` /
+  ``crashed``).
 - ``GET /metrics`` — Prometheus text format from ``ServeMetrics`` plus
-  live pool/stream gauges.
+  live pool/stream/supervision gauges (restarts_total,
+  faults_injected_total, recovery latency, degraded).
 
 Shutdown (SIGTERM/SIGINT): stop admission (503 on new completions),
 finish in-flight streams up to ``drain_timeout``, abort stragglers, and
 only then close the listening socket.
+
+Failure handling: with supervision on (``max_restarts > 0``), a crashed
+or hung (``tick_deadline``) engine tick thread triggers a bounded
+exponential-backoff restart that rebuilds the engine + pool and replays
+every in-flight request teacher-forced (token-identical recovery; see
+``EngineRunner``).  With supervision off, a dead tick thread fails all
+streams cleanly and wedges the server at 503, as before.
 """
 
 from __future__ import annotations
@@ -39,8 +49,10 @@ import itertools
 import json
 import queue as queue_mod
 import signal
+import sys
 import threading
 import time
+from collections import deque
 from typing import Any
 
 from llm_np_cp_tpu.serve.http.protocol import (
@@ -51,6 +63,7 @@ from llm_np_cp_tpu.serve.http.protocol import (
     parse_completion_request,
 )
 from llm_np_cp_tpu.serve.http.sse import DONE_SENTINEL, sse_event
+from llm_np_cp_tpu.serve.metrics import ServeMetrics
 from llm_np_cp_tpu.serve.scheduler import QueueFull
 
 TERMINAL_EVENTS = ("stop", "length", "aborted")
@@ -64,8 +77,8 @@ MAX_BODY_BYTES = 8 << 20
 
 
 class EngineRunner:
-    """Owns the engine tick loop on a worker thread and bridges it to
-    asyncio handlers.
+    """Supervises the engine tick loop on a worker thread and bridges it
+    to asyncio handlers.
 
     Commands (submit/abort) are drained at the top of every loop
     iteration, then one ``engine.step()`` runs if there is work;  when
@@ -74,14 +87,42 @@ class EngineRunner:
     ``("error", msg)`` on the admission verdict, ``("token", id, delta)``
     per generated token, ``("finish", reason, final_text_delta)``
     terminally.
+
+    SUPERVISION (``max_restarts > 0``): a crashed tick thread — or one a
+    watchdog declares hung because no tick heartbeat landed within
+    ``tick_deadline`` — no longer takes the server down.  The runner
+    bumps a *generation* counter (superseding the old thread: if it ever
+    wakes it sees the stale generation and exits without touching the
+    bridges), waits a bounded exponential backoff, rebuilds the engine +
+    block pool (``ServeEngine.clone_fresh`` — the compiled steps are
+    shared, so a restart never recompiles), and REPLAYS every in-flight
+    request with its already-delivered tokens teacher-forced
+    (``ServeEngine.recover`` — the evict-requeue discipline, so the
+    recovered streams are token-identical to an uninterrupted run and no
+    token is ever re-sent).  The command queue survives the restart, so
+    submits that arrive during recovery just queue up; ``/healthz``
+    reports ``degraded`` until the rebuilt engine completes its first
+    loop pass.  Once ``max_restarts`` is exhausted (or with supervision
+    off, the default for library users), the terminal-crash backstop
+    behaves exactly as before: every stream gets a clean ``aborted``
+    event, ``/healthz`` flips 503, new work is refused.
     """
 
     def __init__(self, engine: Any, *, request_timeout: float | None = None,
                  idle_poll_s: float = 0.02,
-                 metrics_max_samples: int = 100_000) -> None:
+                 metrics_max_samples: int = 100_000,
+                 tick_deadline: float | None = None,
+                 max_restarts: int = 0,
+                 restart_backoff_s: float = 0.5,
+                 restart_window_s: float = 300.0) -> None:
         self.engine = engine
+        self.faults = getattr(engine, "faults", None)
         self.request_timeout = request_timeout
         self.idle_poll_s = idle_poll_s
+        self.tick_deadline = tick_deadline
+        self.max_restarts = max_restarts
+        self.restart_backoff_s = restart_backoff_s
+        self.restart_window_s = restart_window_s
         # a server runs for weeks: bound the metrics sample lists
         # (counters stay exact; percentiles become a recent window) and
         # trim the scheduler's terminal-request ledgers below — nothing
@@ -91,34 +132,78 @@ class EngineRunner:
         self._cmds: queue_mod.Queue = queue_mod.Queue()
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
+        self._watchdog: threading.Thread | None = None
         # rid → (loop, asyncio.Queue); written by both threads, but each
         # rid is registered exactly once (submit) and removed exactly
         # once (engine thread, on the terminal event / reject)
         self._live: dict[int, tuple[asyncio.AbstractEventLoop,
                                     asyncio.Queue]] = {}
         self._rid = itertools.count(getattr(engine, "_next_id", 0))
-        # set when the tick thread dies on an unexpected exception: the
-        # server turns /healthz unhealthy and rejects new work instead
-        # of silently wedging every stream
+        # set when the tick thread dies terminally (supervision off or
+        # restart budget exhausted): the server turns /healthz unhealthy
+        # and rejects new work instead of silently wedging every stream
         self.crashed: str | None = None
+        # -- supervision state (everything below guarded by _sup_lock) -
+        # reentrant: _exec holds it across engine.submit/abort (so the
+        # generation check is atomic with the engine call), and abort's
+        # terminal events re-enter it through the _bridge callbacks
+        self._sup_lock = threading.RLock()
+        # commands a superseded thread had in hand when it noticed the
+        # generation bump: drained BEFORE the queue by the live thread,
+        # preserving arrival order (a tail re-put would reorder a submit
+        # behind its own abort)
+        self._handback: deque = deque()
+        self._gen = 0  # engine generation; a restart increments it
+        # lifetime restart count (the restarts_total metric); the BUDGET
+        # is restart INTENSITY — deaths inside restart_window_s — so a
+        # week-long server does not spend its whole allowance on
+        # isolated, fully-recovered blips months apart
+        self.restarts = 0
+        self._recent_deaths: list[float] = []
+        self.recovering = False
+        self.recovery_latency_s: list[float] = []
+        self._death_t: float | None = None
+        self._beat = time.monotonic()
+        # the current restart's backoff delay: the watchdog extends its
+        # staleness budget by this much while recovering, so a wedged
+        # REBUILT engine is still caught (just a little later) instead
+        # of recovery muting the watchdog outright
+        self._backoff_delay = 0.0
+        # replay ledger: rid → {prompt, max_tokens, seed, deadline_s,
+        # tokens delivered so far}, insertion-ordered (original FIFO) —
+        # everything a restart needs to teacher-force the stream back
+        self._inflight: dict[int, dict] = {}
 
     # -- event-loop side ----------------------------------------------
     def start(self) -> None:
-        self._thread = threading.Thread(
-            target=self._run, name="serve-engine-tick", daemon=True,
-        )
-        self._thread.start()
+        self._spawn_thread(self._gen)
+        if self.tick_deadline is not None:
+            self._watchdog = threading.Thread(
+                target=self._watch, name="serve-engine-watchdog",
+                daemon=True,
+            )
+            self._watchdog.start()
 
     def stop(self, timeout: float = 10.0) -> None:
         self._stop.set()
         self._cmds.put(("wake",))
-        if self._thread is not None:
-            self._thread.join(timeout=timeout)
+        thread = self._thread
+        if thread is not None:
+            thread.join(timeout=timeout)
+        if self._watchdog is not None:
+            self._watchdog.join(timeout=1.0)
 
     @property
     def inflight(self) -> int:
         """Live bridged requests (accepted, not yet terminal)."""
         return len(self._live)
+
+    @property
+    def state(self) -> str:
+        """``ok`` | ``degraded`` (restart in progress) | ``crashed``."""
+        if self.crashed:
+            return "crashed"
+        return "degraded" if self.recovering else "ok"
 
     def next_rid(self) -> int:
         return next(self._rid)
@@ -127,11 +212,11 @@ class EngineRunner:
                loop: asyncio.AbstractEventLoop, aq: asyncio.Queue) -> None:
         self._live[rid] = (loop, aq)
         self._cmds.put(("submit", rid, payload))
-        # crash race: if the tick thread died between the handler's
-        # pre-check and this registration, its backstop flush may have
-        # already run — nobody will ever answer this command, so answer
-        # it here (a duplicate event from the flush is harmless: the
-        # handler stops at the first terminal one)
+        # crash race: if the tick thread died terminally between the
+        # handler's pre-check and this registration, its backstop flush
+        # may have already run — nobody will ever answer this command, so
+        # answer it here (a duplicate event from the flush is harmless:
+        # the handler stops at the first terminal one)
         if self.crashed and self._live.pop(rid, None) is not None:
             aq.put_nowait(("error",
                            f"engine tick thread crashed: {self.crashed}"))
@@ -154,7 +239,62 @@ class EngineRunner:
             # loop already closed (shutdown race) — nobody is reading
             self._live.pop(rid, None)
 
-    def _exec(self, cmd: tuple) -> None:
+    def _bridge(self, gen: int) -> tuple:
+        """Per-request engine callbacks for generation ``gen``.  The gen
+        guard (under the supervision lock, so it is atomic with the
+        restart's replay snapshot) makes a superseded engine mute: a hung
+        thread that wakes mid-emit after a restart cannot append to the
+        replay ledger or push duplicate tokens at a stream the rebuilt
+        engine now owns."""
+
+        def cb(req: Any, tok: int, delta: str | None) -> None:
+            with self._sup_lock:
+                if gen != self._gen:
+                    return
+                rec = self._inflight.get(req.req_id)
+                if rec is not None:
+                    rec["tokens"].append(int(tok))
+            self._push(req.req_id, ("token", int(tok), delta))
+
+        def on_event(req: Any, event: str) -> None:
+            if event not in TERMINAL_EVENTS:
+                return
+            with self._sup_lock:
+                if gen != self._gen:
+                    return
+                self._inflight.pop(req.req_id, None)
+            self._push(req.req_id, (
+                "finish", event,
+                req.extra.pop("final_text_delta", None),
+            ))
+            self._live.pop(req.req_id, None)
+
+        return cb, on_event
+
+    def _next_handback(self, gen: int) -> tuple | None:
+        """Pop the next handed-back command — only for the LIVE
+        generation (a stale thread popping and re-appending would rotate
+        the hand-back order)."""
+        with self._sup_lock:
+            if gen == self._gen and self._handback:
+                return self._handback.popleft()
+        return None
+
+    def _exec(self, cmd: tuple, gen: int) -> bool:
+        """Execute one command for generation ``gen``.  The gen check and
+        the engine call are ATOMIC under the supervision lock — a thread
+        superseded between draining a command and executing it must not
+        submit into an engine no thread will ever tick.  Returns False
+        (after handing the command to the live generation, order
+        preserved) when superseded."""
+        with self._sup_lock:
+            if gen != self._gen:
+                self._handback.append(cmd)
+                return False
+            self._exec_inner(cmd, gen)
+        return True
+
+    def _exec_inner(self, cmd: tuple, gen: int) -> None:
         kind = cmd[0]
         if kind == "submit":
             _, rid, payload = cmd
@@ -162,18 +302,7 @@ class EngineRunner:
             if self.request_timeout is not None:
                 deadline = min(deadline or self.request_timeout,
                                self.request_timeout)
-
-            def cb(req: Any, tok: int, delta: str | None) -> None:
-                self._push(req.req_id, ("token", int(tok), delta))
-
-            def on_event(req: Any, event: str) -> None:
-                if event in TERMINAL_EVENTS:
-                    self._push(req.req_id, (
-                        "finish", event,
-                        req.extra.pop("final_text_delta", None),
-                    ))
-                    self._live.pop(req.req_id, None)
-
+            cb, on_event = self._bridge(gen)
             try:
                 self.engine.submit(
                     payload.prompt_ids, payload.max_tokens,
@@ -187,6 +316,14 @@ class EngineRunner:
                 self._push(rid, ("error", str(e)))
                 self._live.pop(rid, None)
             else:
+                self._inflight[rid] = {
+                    "rid": rid,
+                    "prompt": payload.prompt_ids,
+                    "max_tokens": payload.max_tokens,
+                    "seed": payload.seed,
+                    "deadline_s": deadline,
+                    "tokens": [],
+                }
                 self._push(rid, ("accepted",))
         elif kind == "abort":
             self.engine.abort(cmd[1])
@@ -194,10 +331,39 @@ class EngineRunner:
             for rid in list(self._live):
                 self.engine.abort(rid)
 
-    def _run(self) -> None:
-        engine = self.engine
+    # -- supervision ---------------------------------------------------
+    def _spawn_thread(self, gen: int, *, delay: float = 0.0,
+                      replay: list[dict] | None = None) -> None:
+        self._thread = threading.Thread(
+            target=self._run, args=(gen, delay, replay),
+            name=f"serve-engine-tick-{gen}", daemon=True,
+        )
+        self._thread.start()
+
+    def _run(self, gen: int, delay: float = 0.0,
+             replay: list[dict] | None = None) -> None:
         try:
-            while not self._stop.is_set():
+            if delay:
+                time.sleep(delay)  # exponential backoff before rebuild
+            if self._stop.is_set():
+                return
+            if gen == self._gen:
+                self._beat = time.monotonic()  # backoff slept the clock off
+            if replay is not None:
+                self._rebuild_and_replay(gen, replay)
+            self._loop(gen)
+        except BaseException as e:  # noqa: BLE001 — supervisor boundary
+            import traceback
+
+            traceback.print_exc()
+            self._on_engine_death(f"{type(e).__name__}: {e}", gen)
+
+    def _loop(self, gen: int) -> None:
+        engine = self.engine
+        faults = self.faults
+        while not self._stop.is_set() and gen == self._gen:
+            cmd = self._next_handback(gen)
+            if cmd is None:
                 try:
                     block = not engine.scheduler.has_work
                     cmd = self._cmds.get(
@@ -206,34 +372,208 @@ class EngineRunner:
                     )
                 except queue_mod.Empty:
                     cmd = None
-                while cmd is not None:
-                    if cmd[0] != "wake":
-                        self._exec(cmd)
+            while cmd is not None:
+                if cmd[0] != "wake" and not self._exec(cmd, gen):
+                    return  # superseded; _exec handed the command back
+                cmd = self._next_handback(gen)
+                if cmd is None:
                     try:
                         cmd = self._cmds.get_nowait()
                     except queue_mod.Empty:
                         cmd = None
-                if self._stop.is_set():
-                    break
-                if engine.scheduler.has_work:
-                    engine.step()
-                    # terminal requests already delivered their events
-                    # through the bridge — dropping them here keeps a
-                    # long-running server's memory flat
-                    engine.scheduler.finished.clear()
-                    engine.scheduler.aborted.clear()
-        except BaseException as e:  # noqa: BLE001 — last-resort backstop
-            # A dead tick thread must not wedge the server: every
-            # in-flight stream gets a terminal event (clients see a
-            # clean end instead of hanging until their own timeouts),
-            # /healthz flips unhealthy, and new submits are refused.
-            self.crashed = f"{type(e).__name__}: {e}"
-            import traceback
+            if self._stop.is_set() or gen != self._gen:
+                break
+            if engine.scheduler.has_work:
+                if faults is not None:
+                    hang = faults.trip("tick_hang")
+                    if hang is not None:
+                        time.sleep(hang)
+                        if gen != self._gen:
+                            return  # the watchdog already superseded us
+                    if faults.trip("tick_crash") is not None:
+                        from llm_np_cp_tpu.serve.faults import FaultInjected
 
-            traceback.print_exc()
-            for rid in list(self._live):
-                self._push(rid, ("finish", "aborted", None))
-                self._live.pop(rid, None)
+                        raise FaultInjected("tick_crash")
+                engine.step()
+                # terminal requests already delivered their events
+                # through the bridge — dropping them here keeps a
+                # long-running server's memory flat
+                engine.scheduler.finished.clear()
+                engine.scheduler.aborted.clear()
+            # tick heartbeat: the watchdog declares the engine hung when
+            # this goes stale past tick_deadline (idle passes beat every
+            # idle_poll_s, so only a stuck tick can starve it).  Gen
+            # guard: a superseded hung thread that wakes here must not
+            # freshen the heartbeat the NEW generation is judged by
+            if gen == self._gen:
+                self._beat = time.monotonic()
+            if self.recovering:
+                with self._sup_lock:
+                    if gen == self._gen and self.recovering:
+                        self.recovering = False
+                        if self._death_t is not None:
+                            self.recovery_latency_s.append(
+                                time.monotonic() - self._death_t)
+                            self._death_t = None
+
+    def _rebuild_and_replay(self, gen: int, replay: list[dict]) -> None:
+        """Fresh engine + pool (shared compiled steps), then resubmit
+        every in-flight request with its delivered tokens teacher-forced.
+        Runs ON the new tick thread, so engine access stays
+        single-threaded."""
+        old = self.engine
+        # Drop the dead engine's device slabs BEFORE the new pool is
+        # allocated: restart peak memory must stay ~one pool, or an
+        # HBM-sized production pool would OOM every rebuild and turn a
+        # recoverable blip into a terminal 503.  A hung-but-alive thread
+        # that later dispatches into the yanked pool fails in ITS
+        # generation and is ignored.
+        old.pool.pages = None
+        engine = old.clone_fresh()
+        # mute the zombie's counters: the clone shares the REAL metrics
+        # object; a watchdog-superseded-but-alive thread finishing its
+        # slow tick would otherwise keep writing on_token/on_finish into
+        # it (engine internals have no gen guard — only the bridge does)
+        # and double-count with the replay below
+        old.metrics = ServeMetrics(clock=old.clock)
+        with self._sup_lock:
+            if gen != self._gen:
+                # superseded DURING the rebuild (it wedged long enough
+                # for the watchdog to spawn a newer generation, which now
+                # owns self.engine) — walk away without touching anything
+                return
+            self.engine = engine
+        stops = tuple(getattr(engine, "stop_tokens", ()) or ())
+
+        def finish_out_of_band(rec: dict, reason: str) -> None:
+            with self._sup_lock:
+                if gen != self._gen:
+                    return
+                self._inflight.pop(rec["rid"], None)
+            tail = engine.finish_recovered(
+                rec["prompt"], rec["max_tokens"], request_id=rec["rid"],
+                generated=rec["tokens"], reason=reason,
+            )
+            self._push(rec["rid"], ("finish", reason, tail))
+            self._live.pop(rec["rid"], None)
+
+        for rec in replay:
+            if gen != self._gen:
+                return  # superseded mid-replay — the newer thread redoes it
+            rid = rec["rid"]
+            if rid not in self._live:
+                # the stream went away while we were down — drop its
+                # ledger entry too, or it would be re-scanned (and leak)
+                # on every future restart
+                with self._sup_lock:
+                    if gen == self._gen:
+                        self._inflight.pop(rid, None)
+                continue
+            tokens = rec["tokens"]
+            done = len(tokens) >= rec["max_tokens"]
+            stopped = bool(tokens) and tokens[-1] in stops
+            if done or stopped:
+                # fully generated pre-crash; only the finish event was
+                # lost — deliver it without re-running anything
+                finish_out_of_band(rec, "stop" if stopped else "length")
+                continue
+            cb, on_event = self._bridge(gen)
+            try:
+                engine.recover(
+                    rec["prompt"], rec["max_tokens"], request_id=rid,
+                    seed=rec["seed"], generated=tokens, callback=cb,
+                    on_event=on_event, deadline_s=rec["deadline_s"],
+                )
+            except Exception as e:  # noqa: BLE001 — per-request fate
+                # a request the REBUILT pool cannot re-admit (should not
+                # happen — same geometry) fails alone, not the restart
+                finish_out_of_band(rec, "aborted")
+                print(f"[serve] recovery dropped request {rid}: {e}",
+                      file=sys.stderr)
+            if gen == self._gen:
+                self._beat = time.monotonic()
+
+    def _on_engine_death(self, reason: str, gen: int) -> None:
+        """Crash/hang handler (from the dying thread or the watchdog):
+        either schedule a supervised restart or go terminally dark."""
+        now = time.monotonic()
+        with self._sup_lock:
+            if gen != self._gen:
+                return  # a superseded thread died late — already handled
+            # budget = restart intensity, not lifetime total: only
+            # deaths within the window count (a crash LOOP exhausts it;
+            # isolated recovered blips don't), and the backoff exponent
+            # follows the same count so it too is per-incident
+            self._recent_deaths = [
+                t for t in self._recent_deaths
+                if now - t < self.restart_window_s
+            ]
+            if self._stop.is_set() \
+                    or len(self._recent_deaths) >= self.max_restarts:
+                self._terminal_crash(reason)
+                return
+            self._recent_deaths.append(now)
+            self.restarts += 1
+            self._gen += 1
+            self.recovering = True
+            if self._death_t is None:
+                self._death_t = now
+            delay = min(
+                self.restart_backoff_s
+                * (2 ** (len(self._recent_deaths) - 1)),
+                10.0,
+            )
+            self._backoff_delay = delay
+            self._beat = time.monotonic()  # restart clock starts now
+            replay = [dict(rec, tokens=list(rec["tokens"]))
+                      for rec in self._inflight.values()]
+            new_gen = self._gen
+        print(f"[serve] engine death ({reason}); supervised restart "
+              f"{len(replay)} in-flight to replay, "
+              f"{len(self._recent_deaths)}/{self.max_restarts} deaths in "
+              f"window, backoff {delay:.2f}s", file=sys.stderr)
+        self._spawn_thread(new_gen, delay=delay, replay=replay)
+
+    def _terminal_crash(self, reason: str) -> None:
+        """The pre-supervision backstop (caller holds ``_sup_lock``): a
+        dead tick thread must not wedge the server — every in-flight
+        stream gets a terminal event (clients see a clean end instead of
+        hanging until their own timeouts), /healthz flips unhealthy, and
+        new submits are refused."""
+        self.crashed = reason
+        # supersede a HUNG (still running) thread too: without the gen
+        # bump it would wake and keep ticking — a zombie generation
+        # burning the device for already-flushed streams
+        self._gen += 1
+        self.recovering = False
+        for rid in list(self._live):
+            self._push(rid, ("finish", "aborted", None))
+            self._live.pop(rid, None)
+        self._inflight.clear()
+
+    def _watch(self) -> None:
+        """Watchdog: declare the engine hung when the tick heartbeat goes
+        stale past ``tick_deadline`` (a tick stuck in a device call or an
+        injected hang), and hand it to the death handler.  While a
+        restart is in progress the staleness budget stretches by that
+        restart's backoff delay — recovery never MUTES the watchdog, so
+        a rebuilt engine that wedges in its replay or first tick is
+        itself caught and handed back to the supervisor."""
+        assert self.tick_deadline is not None
+        interval = max(self.tick_deadline / 4.0, 0.01)
+        while not self._stop.is_set() and not self.crashed:
+            time.sleep(interval)
+            with self._sup_lock:
+                gen = self._gen
+                beat = self._beat
+                grace = self._backoff_delay if self.recovering else 0.0
+            stale = time.monotonic() - beat
+            if stale > self.tick_deadline + grace:
+                self._on_engine_death(
+                    f"engine tick hung ({stale:.2f}s > tick-deadline "
+                    f"{self.tick_deadline:g}s + {grace:g}s restart grace)",
+                    gen,
+                )
 
 
 class HttpServer:
@@ -249,6 +589,10 @@ class HttpServer:
         drain_timeout: float = 30.0,
         default_max_tokens: int = 16,
         max_tokens_cap: int | None = None,
+        tick_deadline: float | None = None,
+        max_restarts: int = 0,
+        restart_backoff_s: float = 0.5,
+        restart_window_s: float = 300.0,
     ) -> None:
         self.engine = engine
         self.model_id = model_id
@@ -257,7 +601,12 @@ class HttpServer:
         self.drain_timeout = drain_timeout
         self.default_max_tokens = default_max_tokens
         self.max_tokens_cap = max_tokens_cap
-        self.runner = EngineRunner(engine, request_timeout=request_timeout)
+        self.runner = EngineRunner(
+            engine, request_timeout=request_timeout,
+            tick_deadline=tick_deadline, max_restarts=max_restarts,
+            restart_backoff_s=restart_backoff_s,
+            restart_window_s=restart_window_s,
+        )
         self.draining = False
         self.host: str | None = None
         self.port: int | None = None
@@ -354,10 +703,18 @@ class HttpServer:
             return  # torn/oversized request line — nothing to answer
         if method == "GET" and path == "/healthz":
             crashed = self.runner.crashed
+            # degraded (supervised restart in progress) stays 200: the
+            # server still accepts and queues work, so a load balancer
+            # must not eject it mid-recovery — that would turn a blip
+            # back into an outage
             status = 503 if (self.draining or crashed) else 200
             state = ("crashed" if crashed
-                     else "draining" if self.draining else "ok")
-            payload = {"status": state, "model": self.model_id}
+                     else "draining" if self.draining
+                     else self.runner.state)
+            payload = {
+                "status": state, "model": self.model_id,
+                "restarts": self.runner.restarts,
+            }
             if crashed:
                 payload["error"] = crashed
             await self._respond(writer, status, json.dumps(payload).encode())
@@ -403,14 +760,30 @@ class HttpServer:
         return method, path, headers, body
 
     def _render_metrics(self) -> str:
-        stats = self.engine.pool.stats()
-        return self.engine.metrics.prometheus(extra_gauges={
+        # the runner's engine, NOT self.engine: a supervised restart
+        # rebinds it, and a scrape must see the live pool/scheduler
+        engine = self.runner.engine
+        stats = engine.pool.stats()
+        faults = self.runner.faults
+        recov = self.runner.recovery_latency_s
+        return engine.metrics.prometheus(extra_gauges={
             "pool_blocks_free": stats["free"],
             "pool_blocks_request_held": stats["request_held"],
             "pool_blocks_cache_only": stats["cache_only"],
             "inflight_streams": self.runner.inflight,
-            "queue_depth_live": self.engine.scheduler.queue_depth,
+            "queue_depth_live": engine.scheduler.queue_depth,
             "draining": 1.0 if self.draining else 0.0,
+            # supervision observables: the chaos e2e (and an operator's
+            # alerting) read recovery off this scrape
+            "restarts_total": self.runner.restarts,
+            "faults_injected_total": (
+                faults.injected_total if faults is not None else 0.0
+            ),
+            "degraded": 1.0 if self.runner.state == "degraded" else 0.0,
+            "recovery_latency_s_last": recov[-1] if recov else 0.0,
+            "decode_impl_degraded": (
+                1.0 if engine.decode_degraded else 0.0
+            ),
         })
 
     # ------------------------------------------------------------------
@@ -426,6 +799,18 @@ class HttpServer:
                 headers=(("Retry-After", "1"),),
             ))
             return
+        faults = self.runner.faults
+        if faults is not None:
+            retry_after = faults.trip("http_429")
+            if retry_after is not None:
+                # injected transient reject: exercises client
+                # retry/backoff without having to saturate the queue
+                await self._respond_error(writer, HTTPError(
+                    429, "chaos: injected transient reject",
+                    etype="rate_limit_error",
+                    headers=(("Retry-After", f"{max(retry_after, 0):g}"),),
+                ))
+                return
         try:
             payload = parse_completion_request(
                 body, model_id=self.model_id, tokenizer=self.tokenizer,
@@ -533,6 +918,13 @@ class HttpServer:
                     rid, payload.echo_model, created,
                     text=tail or "", token_id=None, finish_reason=reason,
                 )) + DONE_SENTINEL
+            faults = self.runner.faults
+            if faults is not None and faults.trip("http_reset") is not None:
+                # injected socket reset mid-stream: the client sees a
+                # hard RST, the request aborts like any disconnect
+                writer.transport.abort()
+                self.runner.abort(rid)
+                return
             try:
                 writer.write(frame)
                 await writer.drain()
@@ -605,6 +997,10 @@ async def run_server(
     drain_timeout: float = 30.0,
     default_max_tokens: int = 16,
     max_tokens_cap: int | None = None,
+    tick_deadline: float | None = None,
+    max_restarts: int = 0,
+    restart_backoff_s: float = 0.5,
+    restart_window_s: float = 300.0,
     port_file: str | None = None,
     exit_after_s: float | None = None,
     on_started: Any = None,
@@ -615,6 +1011,9 @@ async def run_server(
         request_timeout=request_timeout, drain_timeout=drain_timeout,
         default_max_tokens=default_max_tokens,
         max_tokens_cap=max_tokens_cap,
+        tick_deadline=tick_deadline, max_restarts=max_restarts,
+        restart_backoff_s=restart_backoff_s,
+        restart_window_s=restart_window_s,
     )
     await server.start(host, port)
     if port_file:

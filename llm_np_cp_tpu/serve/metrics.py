@@ -78,6 +78,7 @@ class ServeMetrics:
         self.n_finished = 0
         self.n_aborted = 0
         self.n_rejected = 0
+        self.n_recovered = 0
         self.n_ticks = 0
         self.preemptions = 0
         self.total_generated = 0
@@ -105,6 +106,14 @@ class ServeMetrics:
         """A submit bounced off the queue-depth cap (HTTP 429)."""
         with self._lock:
             self.n_rejected += 1
+
+    def on_recover(self) -> None:
+        """A supervisor replayed an in-flight request into a rebuilt
+        engine (teacher-forced resubmit).  Counted apart from submits —
+        the request was already counted at its original submit, and
+        finish/abort will still fire exactly once."""
+        with self._lock:
+            self.n_recovered += 1
 
     def _trim(self, values: list) -> None:
         # caller holds the lock
@@ -180,6 +189,7 @@ class ServeMetrics:
                 "finished": self.n_finished,
                 "aborted": self.n_aborted,
                 "rejected": self.n_rejected,
+                "recovered": self.n_recovered,
                 "ticks": self.n_ticks,
                 "preemptions": self.preemptions,
                 "total_generated_tokens": self.total_generated,
@@ -255,6 +265,10 @@ class ServeMetrics:
         emit("requests_rejected_total", "counter",
              "Submits bounced off the queue-depth cap (HTTP 429)",
              [("", s["rejected"])])
+        emit("requests_recovered_total", "counter",
+             "In-flight requests replayed into a rebuilt engine after a "
+             "supervised restart",
+             [("", s["recovered"])])
         emit("finish_total", "counter",
              "Terminal events by finish reason",
              [(f'{{reason="{r}"}}', n)

@@ -32,8 +32,9 @@ from __future__ import annotations
 
 import json
 import re
+import time
 from pathlib import Path
-from typing import Any
+from typing import Any, Callable
 
 import jax
 import ml_dtypes
@@ -45,6 +46,61 @@ from llm_np_cp_tpu.models import gemma2, llama, qwen2
 from llm_np_cp_tpu.models.transformer import param_shapes
 
 _LAYER_RE = re.compile(r"^model\.layers\.(\d+)\.(.+)$")
+
+# Transient shard-read IO (NFS blips, object-store mounts dropping a
+# connection) gets a bounded retry instead of killing a multi-minute
+# load; backoff doubles per attempt.  Module-level so tests can shrink
+# the backoff.
+SHARD_READ_RETRIES = 2
+SHARD_READ_BACKOFF_S = 0.5
+
+# These OSError subclasses are configuration mistakes, not flaky IO —
+# retrying a missing file three times only delays and mislabels the
+# diagnosis.
+_PERMANENT_OS_ERRORS = (
+    FileNotFoundError, PermissionError, IsADirectoryError,
+    NotADirectoryError,
+)
+
+# Fault-injection seam: when set, called with the shard path before each
+# read attempt and may raise OSError to simulate transient IO.  Wired by
+# llm_np_cp_tpu.serve.faults.install() — the hook lives HERE so
+# checkpoint loading never imports the serving stack (utils stays below
+# serve in the layering).
+SHARD_READ_HOOK: Callable[[Path], None] | None = None
+
+
+def _read_shard(
+    path: Path, use_native: bool, consume: Callable[[Any, bool], None],
+) -> None:
+    """Open one shard and run ``consume(f, native)`` over it, with a
+    bounded retry on transient ``OSError`` and shard-named, actionable
+    errors otherwise — a failed 9B load must say WHICH shard and tensor
+    disagreed, not dump a raw safetensors traceback.
+
+    Retrying the whole shard is safe: ``consume`` only copies tensors
+    into preallocated buffers (idempotent) and ``filled`` is a set.
+    """
+    for attempt in range(SHARD_READ_RETRIES + 1):
+        try:
+            if SHARD_READ_HOOK is not None:
+                SHARD_READ_HOOK(path)
+            f, native = _open_shard(path, use_native)
+            with f:
+                consume(f, native)
+            return
+        except _PERMANENT_OS_ERRORS:
+            raise  # the OS message already names the path
+        except OSError as e:
+            if attempt >= SHARD_READ_RETRIES:
+                raise OSError(
+                    f"{path.name}: shard read failed after "
+                    f"{SHARD_READ_RETRIES + 1} attempts: {e}"
+                ) from e
+            time.sleep(SHARD_READ_BACKOFF_S * (2 ** attempt))
+        except ValueError as e:
+            # size/key mismatch — permanent; name the shard and re-raise
+            raise ValueError(f"{path.name}: {e}") from e
 
 
 def _key_maps(config: ModelConfig):
@@ -150,40 +206,41 @@ def load_params(
             )
         dest[...] = value.astype(np_dtype)
 
+    def consume(f: Any, native: bool) -> None:
+        for key in f.keys():
+            m = _LAYER_RE.match(key)
+            if m:
+                idx, suffix = int(m.group(1)), m.group(2)
+                if suffix not in layer_map:
+                    continue  # e.g. rotary inv_freq buffers
+                name, transpose = layer_map[suffix]
+                if name not in host["layers"]:
+                    if name.endswith("_bias"):
+                        # A bias tensor the config gated OFF is
+                        # PRESENT in the checkpoint — loading would
+                        # silently drop it and produce wrong logits
+                        # (the round-1 silent-wrongness class)
+                        raise ValueError(
+                            f"{key}: checkpoint carries this bias but "
+                            f"the config disables it "
+                            f"(attention_bias={config.attention_bias}, "
+                            f"attention_out_bias={config.attention_out_bias}, "
+                            f"mlp_bias={config.mlp_bias})"
+                        )
+                    continue
+                fill(f, native, key, host["layers"][name][idx], transpose)
+                filled.add(f"layers.{name}.{idx}")
+            elif key in top_map:
+                name, transpose = top_map[key]
+                if name == "lm_head" and config.tie_word_embeddings:
+                    continue  # tied: forward reuses embed_tokens
+                if name not in host:
+                    continue
+                fill(f, native, key, host[name], transpose)
+                filled.add(name)
+
     for path in shard_files(model_dir):
-        f, native = _open_shard(path, use_native)
-        with f:
-            for key in f.keys():
-                m = _LAYER_RE.match(key)
-                if m:
-                    idx, suffix = int(m.group(1)), m.group(2)
-                    if suffix not in layer_map:
-                        continue  # e.g. rotary inv_freq buffers
-                    name, transpose = layer_map[suffix]
-                    if name not in host["layers"]:
-                        if name.endswith("_bias"):
-                            # A bias tensor the config gated OFF is
-                            # PRESENT in the checkpoint — loading would
-                            # silently drop it and produce wrong logits
-                            # (the round-1 silent-wrongness class)
-                            raise ValueError(
-                                f"{key}: checkpoint carries this bias but "
-                                f"the config disables it "
-                                f"(attention_bias={config.attention_bias}, "
-                                f"attention_out_bias={config.attention_out_bias}, "
-                                f"mlp_bias={config.mlp_bias})"
-                            )
-                        continue
-                    fill(f, native, key, host["layers"][name][idx], transpose)
-                    filled.add(f"layers.{name}.{idx}")
-                elif key in top_map:
-                    name, transpose = top_map[key]
-                    if name == "lm_head" and config.tie_word_embeddings:
-                        continue  # tied: forward reuses embed_tokens
-                    if name not in host:
-                        continue
-                    fill(f, native, key, host[name], transpose)
-                    filled.add(name)
+        _read_shard(path, use_native, consume)
 
     _check_complete(host, filled, config)
 

@@ -37,6 +37,11 @@ def pytest_configure(config):
         "http: serve/http tests — they bind 127.0.0.1:0 (ephemeral "
         "loopback ports only), so tier-1 stays hermetic",
     )
+    config.addinivalue_line(
+        "markers",
+        "chaos: fault-injection / supervised-recovery tests "
+        "(serve/faults.py) — deterministic seeded schedules, in tier-1",
+    )
 
 
 @pytest.fixture

@@ -84,6 +84,7 @@ def test_priority_matches_config_dicts():
         for n in list(bench.DECODE_CONFIGS) + list(bench.SPEC_CONFIGS)
         + list(bench.PREFILL_CONFIGS) + list(bench.RAGGED_CONFIGS)
         + list(bench.SERVE_CONFIGS) + list(bench.SERVE_HTTP_CONFIGS)
+        + list(bench.SERVE_CHAOS_CONFIGS)
         if not n.startswith("smoke")
     }
     assert set(bench.PRIORITY) == non_smoke | bench.EXTRA_CHILDREN
@@ -98,7 +99,8 @@ def test_warm_smoke_offline():
                                  if n not in bench.SPEC_CONFIGS
                                  and n not in bench.EXTRA_CHILDREN
                                  and n not in bench.SERVE_CONFIGS
-                                 and n not in bench.SERVE_HTTP_CONFIGS}
+                                 and n not in bench.SERVE_HTTP_CONFIGS
+                                 and n not in bench.SERVE_CHAOS_CONFIGS}
 
 
 def test_warm_limit_covers_top_priority_only():
@@ -111,7 +113,8 @@ def test_warm_limit_covers_top_priority_only():
                 and n not in bench.EXTRA_CHILDREN
                 and n not in bench.RAGGED_CONFIGS
                 and n not in bench.SERVE_CONFIGS
-                and n not in bench.SERVE_HTTP_CONFIGS]
+                and n not in bench.SERVE_HTTP_CONFIGS
+                and n not in bench.SERVE_CHAOS_CONFIGS]
     assert res["warmed"] == warmable[:3]
 
 
@@ -147,6 +150,24 @@ def test_serve_http_smoke_offline():
     assert res["token_parity_http_vs_direct"] is True
     assert res["ttft_s_p50_http"] > res["ttft_s_p50_direct"] > 0
     assert res["metrics_scrape_ok"] is True
+    assert res["compile_counts"]["decode_step"] == 1
+
+
+@pytest.mark.http
+@pytest.mark.chaos
+def test_serve_chaos_smoke_offline():
+    """The chaos child: clean leg vs seeded-fault leg (tick crash +
+    decode fault + transient 429s) on CPU with the tiny model — every
+    request completes, recovery is token-identical, the restart and
+    recovery latency are recorded, and the decode step never
+    recompiles."""
+    res = bench._spawn("smoke_serve_chaos", 600, env={"BENCH_PLATFORM": "cpu"})
+    assert res.get("ok") is True, res
+    assert res["token_parity_chaos_vs_clean"] is True
+    assert res["restarts"] >= 1
+    assert res["faults_injected"]["injected_tick_crash"] == 1
+    assert res["recovery_latency_s_max"] > 0
+    assert res["client_retries_total"] >= 2  # the injected 429s
     assert res["compile_counts"]["decode_step"] == 1
 
 

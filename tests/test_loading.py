@@ -184,5 +184,59 @@ def test_shape_mismatch_fails_loudly(tmp_path):
     tensors = hf_tensors(src_np, "llama")
     tensors["model.norm.weight"] = np.zeros(7, dtype=np.float32)
     write_checkpoint(tmp_path, cfg, tensors)
-    with pytest.raises(ValueError, match="shape"):
+    with pytest.raises(ValueError, match="shape") as ei:
         load_params(tmp_path, dtype=jnp.float32)
+    # actionable: the error names the shard AND the offending key with
+    # expected/actual shapes — not a raw safetensors traceback
+    assert ".safetensors" in str(ei.value)
+    assert "model.norm.weight" in str(ei.value)
+
+
+@pytest.mark.chaos
+def test_transient_shard_read_error_retries_and_succeeds(tmp_path, monkeypatch):
+    """Two injected transient IOErrors on shard reads (the NFS-blip /
+    object-store-reset shape): the bounded retry absorbs them and the
+    load completes bit-identically."""
+    from llm_np_cp_tpu.serve import faults
+    from llm_np_cp_tpu.utils import loading
+
+    cfg = tiny_config("llama", num_hidden_layers=2)
+    src_np = jax.tree.map(
+        lambda x: np.asarray(x, np.float32),
+        init_params(jax.random.PRNGKey(6), cfg, dtype=jnp.float32),
+    )
+    write_checkpoint(tmp_path, cfg, hf_tensors(src_np, "llama"))
+    monkeypatch.setattr(loading, "SHARD_READ_BACKOFF_S", 0.0)
+    inj = faults.FaultInjector("ckpt_read@1:2")
+    faults.install(inj)
+    try:
+        params, _ = load_params(tmp_path, dtype=jnp.float32)
+    finally:
+        faults.install(None)
+    assert inj.injected["ckpt_read"] == 2
+    np.testing.assert_array_equal(
+        np.asarray(params["embed_tokens"]), src_np["embed_tokens"]
+    )
+
+
+@pytest.mark.chaos
+def test_persistent_shard_read_error_fails_actionably(tmp_path, monkeypatch):
+    """More consecutive IOErrors than the retry budget: the final error
+    names the shard and the attempt count."""
+    from llm_np_cp_tpu.serve import faults
+    from llm_np_cp_tpu.utils import loading
+
+    cfg = tiny_config("llama", num_hidden_layers=2)
+    src_np = jax.tree.map(
+        lambda x: np.asarray(x, np.float32),
+        init_params(jax.random.PRNGKey(7), cfg, dtype=jnp.float32),
+    )
+    write_checkpoint(tmp_path, cfg, hf_tensors(src_np, "llama"))
+    monkeypatch.setattr(loading, "SHARD_READ_BACKOFF_S", 0.0)
+    faults.install(faults.FaultInjector("ckpt_read@1:99"))
+    try:
+        with pytest.raises(OSError, match="after 3 attempts") as ei:
+            load_params(tmp_path, dtype=jnp.float32)
+    finally:
+        faults.install(None)
+    assert ".safetensors" in str(ei.value)

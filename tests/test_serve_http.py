@@ -368,6 +368,49 @@ def test_http_tick_thread_crash_fails_streams_and_health(tiny):
     asyncio.run(asyncio.wait_for(main(), timeout=120))
 
 
+def test_deadline_expiry_during_drain_aborts_and_drain_completes(tiny):
+    """A per-request deadline that expires WHILE a SIGTERM drain is in
+    progress must still be swept: the stream finishes ``aborted``, its
+    blocks decref, and the drain completes promptly instead of waiting
+    out the full --drain-timeout on a request that will never finish."""
+    cfg, params = tiny
+    engine = _engine(cfg, params)
+
+    async def main():
+        srv = HttpServer(engine, model_id="tiny", drain_timeout=30.0)
+        await srv.start("127.0.0.1", 0)
+        loop = asyncio.get_running_loop()
+        # a budget far larger than the deadline allows: without the sweep
+        # this stream would pin the drain until drain_timeout
+        task = asyncio.create_task(astream_completion(
+            srv.host, srv.port,
+            {"prompt": [7] * 9, "max_tokens": 40, "stream": True,
+             "timeout_s": 0.6},
+        ))
+        # drain begins while the stream is mid-decode, before its deadline
+        deadline = time.time() + 20
+        while not engine.metrics.snapshot()["total_generated_tokens"] \
+                and time.time() < deadline:
+            await asyncio.sleep(0.01)
+        t_drain = loop.time()
+        srv.begin_drain()
+        res = await asyncio.wait_for(task, timeout=30)
+        await asyncio.wait_for(srv.serve_until_shutdown(), timeout=30)
+        drain_s = loop.time() - t_drain
+        assert res["finish_reason"] == "aborted"
+        assert 0 < len(res["token_ids"]) < 40
+        # the sweep, not the drain timeout, ended it: well under the 30s
+        # drain window (deadline 0.6s + terminal-event flush)
+        assert drain_s < 15.0, f"drain stalled for {drain_s:.1f}s"
+
+    asyncio.run(asyncio.wait_for(main(), timeout=120))
+    assert engine.pool.stats()["request_held"] == 0
+    snap = engine.metrics.snapshot()
+    assert snap["aborted"] == 1
+    assert snap["finish_reasons"]["aborted"] == 1
+    assert not engine.scheduler.has_work
+
+
 # ---------------------------------------------------------------------------
 # The acceptance scenario
 # ---------------------------------------------------------------------------

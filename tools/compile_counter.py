@@ -193,6 +193,33 @@ def _self_check() -> None:
     assert held == 0, f"abort churn leaked {held} blocks"
     print(f"compile counts OK (abort churn): {eng.compile_counts()}")
 
+    # supervised restart + recovery replay: a rebuilt engine
+    # (clone_fresh, identical geometry) SHARES the compiled step
+    # programs, and replaying in-flight requests teacher-forced
+    # (engine.recover — the evict-requeue path across a rebuild) must
+    # not compile ANYTHING new — restart cost is pool rebuild + replay
+    # prefills, never a retrace.  The decode step in particular stays at
+    # its single compile across the rebuild.
+    warm = dict(eng.compile_counts())
+    live = [eng.submit(p, 6) for p in prompts]
+    for _ in range(2):
+        eng.step()  # some requests mid-decode, some still queued
+    rebuilt = eng.clone_fresh()
+    for r in live:
+        rebuilt.recover(
+            r.prompt, r.max_new_tokens, request_id=r.req_id, seed=r.seed,
+            generated=list(r.generated),
+        )
+    rebuilt.run_until_complete()
+    assert rebuilt.compile_counts() == warm, (
+        f"engine restart + recovery replay recompiled: "
+        f"{warm} -> {rebuilt.compile_counts()}"
+    )
+    assert rebuilt.compile_counts()["decode_step"] == 1
+    held = rebuilt.pool.stats()["request_held"]
+    assert held == 0, f"recovery replay leaked {held} blocks"
+    print(f"compile counts OK (restart+recovery): {rebuilt.compile_counts()}")
+
 
 if __name__ == "__main__":
     _self_check()
