@@ -972,10 +972,44 @@ def run_serve_http_config(name: str) -> dict:
     d_p50 = direct.get("ttft_s_p50", float("nan"))
     d_p99 = direct.get("ttft_s_p99", float("nan"))
     h_p50, h_p99 = pct(ttft_http, 50), pct(ttft_http, 99)
+
+    # leg 3: tracing overhead — the SAME trace, direct realtime replay
+    # again but with a TraceRecorder attached (request spans + tick
+    # phases + profiler annotations live).  The delta vs the untraced
+    # direct leg is what --trace-out costs a production replay; it must
+    # stay small or the instrument perturbs what it measures.
+    from llm_np_cp_tpu.serve.tracing import TraceRecorder
+
+    engine.metrics = ServeMetrics(clock=engine.clock)
+    engine.scheduler.finished.clear()
+    engine.tracer = TraceRecorder(ring=500_000)
+    traced = engine.replay_trace(trace, realtime=True)
+    # ids keep counting across legs — compare token streams in submit
+    # order (both legs replay the same arrivals through submit())
+    trace_parity = (
+        [t for _, t in sorted(
+            (r.req_id, r.generated) for r in engine.scheduler.finished)]
+        == [direct_tokens[k] for k in sorted(direct_tokens)]
+    )
+    n_trace_events = len(engine.tracer)
+    engine.tracer = None
+    _phase(name, "traced_done", t0, events=n_trace_events)
+    t_p99 = traced.get("ttft_s_p99", float("nan"))
+    trace_tok_delta = round(
+        direct["throughput_tok_s"] - traced["throughput_tok_s"], 1)
+    trace_p99_delta = round(t_p99 - d_p99, 4)
+    # generous bounds — this guards against a broken hot path (tracing
+    # turning ticks into seconds), not against scheduler jitter
+    trace_overhead_small = (
+        traced["throughput_tok_s"] >= 0.7 * direct["throughput_tok_s"]
+        and (t_p99 - d_p99) < max(0.25, d_p99)
+    )
     return {
         "config": name,
         "ok": (direct["finished"] == spec["requests"]
-               and len(http_ok) == spec["requests"] and parity),
+               and len(http_ok) == spec["requests"] and parity
+               and traced["finished"] == spec["requests"]
+               and trace_parity and trace_overhead_small),
         "requests": spec["requests"],
         "rate_rps": spec["rate"],
         "slots": spec["slots"],
@@ -992,6 +1026,14 @@ def run_serve_http_config(name: str) -> dict:
         "throughput_tok_s_direct": round(direct["throughput_tok_s"], 1),
         "throughput_tok_s_http": round(http_snap["throughput_tok_s"], 1),
         "metrics_scrape_ok": "llm_serve_requests_finished_total" in prom,
+        # the traced leg: what request-lifecycle tracing costs
+        "throughput_tok_s_traced": round(traced["throughput_tok_s"], 1),
+        "ttft_s_p99_traced": round(t_p99, 4),
+        "trace_overhead_tok_s": trace_tok_delta,
+        "trace_overhead_ttft_p99_s": trace_p99_delta,
+        "trace_overhead_small": trace_overhead_small,
+        "trace_events": n_trace_events,
+        "trace_token_parity": trace_parity,
         "compile_counts": engine.compile_counts(),
     }
 

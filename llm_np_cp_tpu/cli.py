@@ -165,6 +165,26 @@ def _add_serve_engine_flags(p: argparse.ArgumentParser,
     p.add_argument("--chaos-seed", type=int, default=0,
                    help="seed for probabilistic chaos events (a fixed "
                    "seed replays the identical fault schedule)")
+    p.add_argument("--trace-out", default=None, metavar="PATH",
+                   help="write the request-lifecycle + tick-phase "
+                   "timeline as Chrome/Perfetto trace-event JSON to "
+                   "PATH on exit (open at ui.perfetto.dev; summarize "
+                   "with tools/summarize_trace.py).  Default: tracing "
+                   "off — every hook is a zero-overhead no-op")
+    p.add_argument("--trace-ring", type=int, default=0, metavar="N",
+                   help="keep only the newest N trace events in memory "
+                   "(bounded for long-running servers; served live at "
+                   "GET /debug/trace).  0 = unbounded when --trace-out "
+                   "is set, else tracing off")
+    p.add_argument("--jax-profile", default=None, metavar="DIR",
+                   help="capture a jax.profiler device trace into DIR "
+                   "for the run; the serve dispatch phases are wrapped "
+                   "in TraceAnnotation scopes, so the device profile "
+                   "lines up against the host timeline from --trace-out. "
+                   "Implies host tracing (the annotation scopes only "
+                   "exist while a recorder is attached); give "
+                   "--trace-ring/--trace-out to control the recorder, "
+                   "else a bounded default ring is used")
 
 
 def build_serve_parser(default_model: str) -> argparse.ArgumentParser:
@@ -252,6 +272,10 @@ def _validate_pool_flags(args) -> None:
         raise SystemExit(
             f"--block-size must be a multiple of 8, got {args.block_size}"
         )
+    if getattr(args, "trace_ring", 0) < 0:
+        raise SystemExit(
+            f"--trace-ring must be >= 0, got {args.trace_ring}"
+        )
 
 
 def _chaos_injector(args):
@@ -321,6 +345,29 @@ def _build_serve_engine(args, params, config, *, prog: str,
     else:
         decode_attn_impl = gather_impl
 
+    # tracing on iff requested (--trace-out / --trace-ring / implied by
+    # --jax-profile — the TraceAnnotation scopes that correlate the
+    # device profile only exist while a recorder is attached): the
+    # recorder's absence IS the off switch — every engine/HTTP hook is
+    # a single is-None check when it is None
+    tracer = None
+    jax_profile = getattr(args, "jax_profile", None)
+    if args.trace_out or args.trace_ring or jax_profile:
+        from llm_np_cp_tpu.serve.tracing import TraceRecorder
+
+        ring = args.trace_ring or None
+        if ring is None and not args.trace_out:
+            # --jax-profile alone: the recorder exists for its
+            # annotation scopes — keep its memory bounded
+            ring = 100_000
+        tracer = TraceRecorder(ring=ring)
+        print(f"[{prog}] tracing ACTIVE (ring={ring or 'unbounded'}"
+              + (f", dump to {args.trace_out}" if args.trace_out else "")
+              + (", implied by --jax-profile"
+                 if jax_profile and not (args.trace_out or args.trace_ring)
+                 else "")
+              + ")")
+
     # same chunking as bench.run_serve_config, so the README's CLI line
     # compiles the same prefill programs as the recorded bench numbers
     chunk = min(args.block_size * 2, 256)
@@ -343,8 +390,33 @@ def _build_serve_engine(args, params, config, *, prog: str,
         max_queue=max_queue,
         tokenizer=tokenizer,
         fault_injector=fault_injector,
+        tracer=tracer,
     )
     return engine, num_blocks
+
+
+def _jax_profile_ctx(args):
+    """--jax-profile DIR → a jax.profiler trace context (device timeline
+    correlatable with the host trace via the TraceAnnotation scopes), or
+    a no-op context."""
+    import contextlib
+
+    if not getattr(args, "jax_profile", None):
+        return contextlib.nullcontext()
+    from llm_np_cp_tpu.utils.profiling import trace as jax_trace
+
+    return jax_trace(args.jax_profile)
+
+
+def _dump_trace(tracer, args, prog: str) -> None:
+    # takes the RECORDER, not the engine: a supervised restart mutes the
+    # dead engine's tracer attribute, but the recorder object (shared by
+    # every rebuilt engine) holds the full timeline
+    if args.trace_out and tracer is not None:
+        n = tracer.dump(args.trace_out)
+        print(f"[{prog}] wrote {n} trace events to {args.trace_out}"
+              + (f" ({tracer.dropped} dropped by the ring)"
+                 if tracer.dropped else ""))
 
 
 def _run_serve_bench(argv: list[str], default_model: str) -> str:
@@ -375,7 +447,9 @@ def _run_serve_bench(argv: list[str], default_model: str) -> str:
     # compile outside the measured span (steady-state numbers only)
     engine.warmup([int(t["prompt"].size) for t in trace],
                   max_new_tokens=args.max_tokens)
-    snap = engine.replay_trace(trace, realtime=args.realtime)
+    with _jax_profile_ctx(args):
+        snap = engine.replay_trace(trace, realtime=args.realtime)
+    _dump_trace(engine.tracer, args, "serve-bench")
     out = (
         f"[serve-bench] {args.requests} requests @ {args.rate} req/s, "
         f"slots={args.slots}, pool={num_blocks}x{args.block_size} "
@@ -414,6 +488,9 @@ def _run_http_serve(argv: list[str], default_model: str) -> str:
         args, params, config, prog="serve", tokenizer=tok,
         max_queue=args.max_queue or None, fault_injector=injector,
     )
+    # hold the recorder here: a supervised restart rebinds the runner's
+    # engine and mutes the dead one's tracer attribute
+    tracer = engine.tracer
     # warm the phase programs BEFORE accepting traffic: the first real
     # request must not pay a multi-second model compile in its TTFT
     engine.warmup([args.prompt_len], max_new_tokens=args.max_tokens)
@@ -431,23 +508,25 @@ def _run_http_serve(argv: list[str], default_model: str) -> str:
         print(f"[serve] listening on http://{server.host}:{server.port} "
               f"(POST /v1/completions, GET /healthz, GET /metrics)")
 
-    serve_forever(
-        engine,
-        model_id=args.model,
-        tokenizer=tok,
-        host=args.host,
-        port=args.port,
-        request_timeout=args.request_timeout or None,
-        drain_timeout=args.drain_timeout,
-        default_max_tokens=args.max_tokens,
-        max_tokens_cap=args.max_tokens,
-        tick_deadline=args.tick_deadline or None,
-        max_restarts=args.max_restarts,
-        restart_window_s=args.restart_window,
-        port_file=args.port_file,
-        exit_after_s=args.exit_after_s,
-        on_started=on_started,
-    )
+    with _jax_profile_ctx(args):
+        serve_forever(
+            engine,
+            model_id=args.model,
+            tokenizer=tok,
+            host=args.host,
+            port=args.port,
+            request_timeout=args.request_timeout or None,
+            drain_timeout=args.drain_timeout,
+            default_max_tokens=args.max_tokens,
+            max_tokens_cap=args.max_tokens,
+            tick_deadline=args.tick_deadline or None,
+            max_restarts=args.max_restarts,
+            restart_window_s=args.restart_window,
+            port_file=args.port_file,
+            exit_after_s=args.exit_after_s,
+            on_started=on_started,
+        )
+    _dump_trace(tracer, args, "serve")
     print("[serve] drained, bye")
     return banner
 

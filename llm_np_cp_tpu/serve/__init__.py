@@ -29,9 +29,15 @@ Modules:
   no-op (one is-None check) by default.
 - ``metrics``     — queue depth, TTFT, per-request decode tok/s, pool
   occupancy, preemptions, aborts/rejects, prefix hit-rate, K/V bytes per
-  tick; exported as a dict and as Prometheus text (thread-safe
-  copy-on-read snapshots — the HTTP scrape handler reads while the
-  engine thread writes).
+  tick, per-request queue-wait/prefill phase splits; exported as a dict
+  and as Prometheus text with real TTFT/decode-rate histograms
+  (thread-safe copy-on-read snapshots — the HTTP scrape handler reads
+  while the engine thread writes).
+- ``tracing``     — request-lifecycle spans (queued → prefill → decode
+  → finish, with eviction/recovery annotations) and per-tick phase
+  slices as Chrome/Perfetto trace-event JSON (``TraceRecorder``);
+  zero-overhead is-None hooks when off, ring-buffered for the
+  ``GET /debug/trace`` endpoint, dumped via ``--trace-out``.
 - ``http``        — the OpenAI-compatible streaming HTTP front-end
   (``serve`` CLI subcommand): SSE token streams, abort on disconnect or
   deadline, 429 backpressure off the scheduler's queue cap, Prometheus
@@ -54,6 +60,7 @@ from llm_np_cp_tpu.serve.scheduler import (
     Scheduler,
 )
 from llm_np_cp_tpu.serve.trace import poisson_trace
+from llm_np_cp_tpu.serve.tracing import TraceRecorder
 
 __all__ = [
     "BlockPool",
@@ -67,6 +74,7 @@ __all__ = [
     "Scheduler",
     "ServeEngine",
     "ServeMetrics",
+    "TraceRecorder",
     "poisson_trace",
     "pool_geometry",
     "prefix_block_keys",

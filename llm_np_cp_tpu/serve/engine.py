@@ -48,6 +48,7 @@ into the temp prefill cache so the remaining chunks attend correctly.
 
 from __future__ import annotations
 
+import contextlib
 import math
 import time
 from functools import partial
@@ -81,8 +82,14 @@ from llm_np_cp_tpu.serve.scheduler import (
     RequestState,
     Scheduler,
 )
+from llm_np_cp_tpu.serve.tracing import TraceRecorder
 
 Params = dict[str, Any]
+
+# Shared no-op context for the tracing-off branch of the profiler-scope
+# hooks: ``nullcontext()`` per tick would be a per-tick allocation on
+# the hot path — exactly what the tracing-off discipline forbids.
+_NULL_CTX = contextlib.nullcontext()
 
 
 def _ceil_to(n: int, g: int) -> int:
@@ -156,6 +163,7 @@ class ServeEngine:
         tokenizer: Any = None,
         clock: Callable[[], float] = time.perf_counter,
         fault_injector: FaultInjector | None = None,
+        tracer: TraceRecorder | None = None,
     ) -> None:
         if decode_attn_impl not in ("xla", "flash_decode", "paged"):
             raise ValueError(
@@ -171,6 +179,10 @@ class ServeEngine:
         # seeded chaos schedule (serve/faults.py); None = every injection
         # point is a single is-None check (zero overhead)
         self.faults = fault_injector
+        # request/tick trace recorder (serve/tracing.py); None = every
+        # hook is a single is-None check, same discipline as faults
+        # (pinned by tools/compile_counter.assert_tracing_hooks_guarded)
+        self.tracer = tracer
         # reason string once the paged decode step faulted at dispatch
         # and the engine fell back to the gather impl (None = healthy)
         self.decode_degraded: str | None = None
@@ -668,6 +680,13 @@ class ServeEngine:
             self.metrics.on_recover()
         else:
             self.metrics.on_submit(req)
+        if self.tracer is not None:
+            self.tracer.request_phase(req.req_id, "queued", args={
+                "prompt_len": req.prompt_len,
+                "max_new_tokens": max_new_tokens,
+            })
+            if _recovered:
+                self.tracer.request_instant(req.req_id, "recovery-replay")
         self._requests[req.req_id] = req
         if self.tokenizer is not None:
             self._detok[req.req_id] = IncrementalDetok(self.tokenizer)
@@ -684,6 +703,7 @@ class ServeEngine:
         callback: Callable[[Request, int, str | None], None] | None = None,
         on_event: Callable[[Request, str], None] | None = None,
         deadline_s: float | None = None,
+        deadline_at: float | None = None,
     ) -> Request:
         """Resubmit a request that was in flight when a previous engine
         instance died, with its already-delivered tokens teacher-forced.
@@ -694,12 +714,22 @@ class ServeEngine:
         decode RNG keys derive from (seed, content position) — the
         continuation is token-identical to an uninterrupted run, and the
         pre-seeded tokens are NOT re-emitted through the callback.
-        ``deadline_s`` restarts relative to now (a recovered request gets
-        its full window back rather than being instantly swept).  The
+
+        Deadlines resume the REMAINING budget: ``deadline_at`` is the
+        original absolute deadline on the engine clock (clone_fresh
+        shares the clock, so it stays comparable across rebuilds) — a
+        request promised N seconds at submit is not silently granted a
+        fresh window by every crash (a crash loop would otherwise make
+        its deadline unenforceable).  A deadline that expired while the
+        engine was down is swept (aborted) on the first tick, exactly as
+        if the engine had lived.  ``deadline_s`` (a fresh window from
+        now) remains for callers that genuinely want a restart.  The
         caller filters requests that were already terminal (``generated``
         at budget, or ending in a stop token) — those need only their
         lost finish event, not a resubmit.
         """
+        if deadline_s is not None and deadline_at is not None:
+            raise ValueError("pass deadline_s or deadline_at, not both")
         if len(generated) >= max_new_tokens:
             raise ValueError(
                 f"request {request_id} already generated "
@@ -711,6 +741,8 @@ class ServeEngine:
             callback=callback, on_event=on_event, deadline_s=deadline_s,
             _recovered=True,
         )
+        if deadline_at is not None:
+            req.deadline = deadline_at
         req.generated = [int(t) for t in generated]
         detok = self._detok.get(req.req_id)
         if detok is not None:
@@ -751,6 +783,12 @@ class ServeEngine:
             self.metrics.on_abort(req)
         else:
             self.metrics.on_finish(req)
+        if self.tracer is not None:
+            # close whatever span the pre-crash engine left open so the
+            # span-vs-metrics parity (finish instants == terminal
+            # counters) holds across recoveries too
+            self.tracer.request_end(request_id, reason,
+                                    args={"recovered_terminal": True})
         if self.tokenizer is None or not req.generated:
             return None
         detok = IncrementalDetok(self.tokenizer)
@@ -781,6 +819,7 @@ class ServeEngine:
             tokenizer=self.tokenizer,
             clock=self.clock,
             fault_injector=self.faults,
+            tracer=self.tracer,
         )
         eng.metrics = self.metrics
         eng.decode_degraded = self.decode_degraded
@@ -840,6 +879,8 @@ class ServeEngine:
             self._requests.pop(req.req_id, None)
             self._flush_detok(req)
             self.metrics.on_finish(req)
+            if self.tracer is not None:
+                self.tracer.request_end(req.req_id, req.finish_reason)
             self._emit_event(req, req.finish_reason)
             return True
         return False
@@ -867,6 +908,8 @@ class ServeEngine:
         req.finish_time = self.clock()
         self._flush_detok(req)
         self.metrics.on_abort(req)
+        if self.tracer is not None:
+            self.tracer.request_end(req.req_id, "aborted")
         self._emit_event(req, "aborted")
         return True
 
@@ -923,9 +966,26 @@ class ServeEngine:
         last = None
         for off in range(shared_slots, w, self.prefill_chunk):
             end = off + self.prefill_chunk
-            last, cache = self._prefill_step(
-                self.params, ids_d[:, off:end], cache, mask_d[:, off:end], pads
-            )
+            # self.tracer re-read per hook, like step(): the supervisor
+            # mutes a zombie engine by clearing the attribute
+            t_chunk = (self.tracer.now_us()
+                       if self.tracer is not None else -1.0)
+            with (jax.profiler.TraceAnnotation("serve.prefill_chunk")
+                  if self.tracer is not None else _NULL_CTX):
+                last, cache = self._prefill_step(
+                    self.params, ids_d[:, off:end], cache,
+                    mask_d[:, off:end], pads,
+                )
+            if self.tracer is not None and t_chunk >= 0.0:
+                # dispatch time, not device time — async dispatch
+                # returns before the chunk computes; the device side
+                # lives in the --jax-profile capture under the
+                # TraceAnnotation scope above
+                self.tracer.complete(
+                    "prefill_chunk", t_chunk, cat="prefill", args={
+                        "rid": req.req_id, "offset": off,
+                        "width": end - off,
+                    })
         self.pool.pages = self._scatter_prefill(
             self.pool.pages, cache,
             jnp.asarray(np.asarray(req.block_ids[n_shared:], dtype=np.int32)),
@@ -951,19 +1011,57 @@ class ServeEngine:
     def step(self) -> bool:
         """One scheduler tick: deadline sweep, admissions (+prefill),
         then one packed decode dispatch.  Returns True while work
-        remains."""
+        remains.
+
+        With a tracer attached each tick emits one ``tick`` span and its
+        phase slices — ``admission`` (sweep + admit), ``prefill``,
+        ``grow`` (block growth / eviction), ``decode_dispatch``,
+        ``host_sync`` (the device→host token fetch) and ``deliver``
+        (callbacks + metrics) — measured at consecutive timestamps so
+        the phases sum to the tick span.  Tracing off: every hook is a
+        single is-None branch (no allocation, pinned by lint).
+
+        ``self.tracer`` is re-read at EVERY hook (never cached in a
+        local for the whole tick) for the same reason engine code reads
+        ``self.metrics`` per call: a supervisor restart mutes the dead
+        engine by clearing the attribute, and a watchdog-superseded but
+        still-running zombie tick must stop writing into the shared
+        recorder as soon as that mute lands — a tick-lifetime snapshot
+        would keep emitting stale spans into the timeline the rebuilt
+        engine now owns.  Timestamps default to -1 so a tick that
+        STARTED untraced never emits a garbage span if a tracer is
+        attached mid-tick."""
+        t0 = self.tracer.now_us() if self.tracer is not None else -1.0
         self._sweep_deadlines()
-        for req in self.scheduler.admit():
+        admitted = self.scheduler.admit()
+        t1 = self.tracer.now_us() if self.tracer is not None else -1.0
+        for req in admitted:
+            t_req = self.clock()
+            if req.admit_time is None:
+                req.admit_time = t_req
+            if self.tracer is not None:
+                self.tracer.request_phase(req.req_id, "prefill", args={
+                    "shared_blocks": req.n_shared_blocks,
+                    "preemptions": req.n_preemptions,
+                })
             self._prefill_request(req)
-            self._maybe_finish(req)
+            req.prefill_s += self.clock() - t_req
+            if not self._maybe_finish(req) and self.tracer is not None:
+                self.tracer.request_phase(req.req_id, "decode")
+        t2 = self.tracer.now_us() if self.tracer is not None else -1.0
 
         # preempted requests are already requeued; slots rebuilt below
         for req in self.scheduler.ensure_decode_blocks():
+            if self.tracer is not None:
+                self.tracer.request_instant(req.req_id, "evicted-requeued")
+                self.tracer.request_phase(req.req_id, "queued")
             self._emit_event(req, "evicted-requeued")
+        t3 = self.tracer.now_us() if self.tracer is not None else -1.0
 
         running = [
             r for r in self.scheduler.running if r.generated
         ]
+        t4 = t5 = t3
         if running:
             b = self.scheduler.max_slots
             mb = self.max_blocks_per_seq
@@ -980,11 +1078,16 @@ class ServeEngine:
                 pads[r.slot] = r.pad
                 toks[r.slot] = r.generated[-1]
                 seeds[r.slot] = np.uint32(r.seed)
-            nxt, self.pool.pages = self._dispatch_decode(
-                jnp.asarray(tables), jnp.asarray(lengths), jnp.asarray(pads),
-                jnp.asarray(toks), jnp.asarray(seeds),
-            )
+            with (jax.profiler.TraceAnnotation("serve.decode_dispatch")
+                  if self.tracer is not None else _NULL_CTX):
+                nxt, self.pool.pages = self._dispatch_decode(
+                    jnp.asarray(tables), jnp.asarray(lengths),
+                    jnp.asarray(pads), jnp.asarray(toks),
+                    jnp.asarray(seeds),
+                )
+            t4 = self.tracer.now_us() if self.tracer is not None else -1.0
             nxt_host = np.asarray(nxt)
+            t5 = self.tracer.now_us() if self.tracer is not None else -1.0
             for r in running:
                 self._emit(r, int(nxt_host[r.slot]))
                 self._maybe_finish(r)
@@ -996,6 +1099,17 @@ class ServeEngine:
             preemptions_total=self.scheduler.n_preemptions,
             kv_bytes=self._kv_bytes_tick(running) if running else 0,
         )
+        if self.tracer is not None and t0 >= 0.0:
+            t6 = self.tracer.now_us()
+            self.tracer.tick(t0, (
+                ("admission", t0, t1), ("prefill", t1, t2),
+                ("grow", t2, t3), ("decode_dispatch", t3, t4),
+                ("host_sync", t4, t5), ("deliver", t5, t6),
+            ), args={
+                "active_slots": len(running) if running else 0,
+                "queue_depth": self.scheduler.queue_depth,
+                "admitted": len(admitted),
+            })
         return self.scheduler.has_work
 
     def _dispatch_decode(self, *args: jnp.ndarray) -> tuple:
@@ -1097,12 +1211,16 @@ class ServeEngine:
         # chaos is suspended for the warmup pass: it is compile-only, so
         # its dispatches must not consume deterministic schedule hits
         # (shifting every site's firing point) and a scheduled fault must
-        # not fire here, where no supervisor is watching yet
+        # not fire here, where no supervisor is watching yet.  The tracer
+        # is suspended with it — warmup's dummy request is not part of
+        # any measured timeline, like the metrics reset below.
         faults, self.faults = self.faults, None
+        tracer, self.tracer = self.tracer, None
         try:
             self._warmup_body(prompt_lens, max_new_tokens)
         finally:
             self.faults = faults
+            self.tracer = tracer
 
     def _warmup_body(self, prompt_lens: list[int],
                      max_new_tokens: int) -> None:

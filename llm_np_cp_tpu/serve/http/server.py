@@ -28,6 +28,12 @@ Endpoints:
 - ``GET /metrics`` — Prometheus text format from ``ServeMetrics`` plus
   live pool/stream/supervision gauges (restarts_total,
   faults_injected_total, recovery latency, degraded).
+- ``GET /debug/trace`` — the tracing ring buffer (serve/tracing.py) as
+  Chrome/Perfetto trace-event JSON, when the server was started with
+  tracing on (``--trace-ring`` / ``--trace-out``); 404 otherwise.  With
+  tracing on, every completion's span starts at socket accept (an
+  ``http`` bracket around the engine's queued/prefill/decode spans), so
+  network+parse time is separable from queue wait.
 
 Shutdown (SIGTERM/SIGINT): stop admission (503 on new completions),
 finish in-flight streams up to ``drain_timeout``, abort stragglers, and
@@ -304,7 +310,7 @@ class EngineRunner:
                                self.request_timeout)
             cb, on_event = self._bridge(gen)
             try:
-                self.engine.submit(
+                req = self.engine.submit(
                     payload.prompt_ids, payload.max_tokens,
                     request_id=rid, seed=payload.seed, callback=cb,
                     on_event=on_event, deadline_s=deadline,
@@ -321,7 +327,11 @@ class EngineRunner:
                     "prompt": payload.prompt_ids,
                     "max_tokens": payload.max_tokens,
                     "seed": payload.seed,
-                    "deadline_s": deadline,
+                    # the ABSOLUTE deadline on the engine clock (shared
+                    # by clone_fresh rebuilds): recovery resumes the
+                    # remaining budget instead of granting a fresh
+                    # window per crash
+                    "deadline_at": req.deadline,
                     "tokens": [],
                 }
                 self._push(rid, ("accepted",))
@@ -422,6 +432,8 @@ class EngineRunner:
         Runs ON the new tick thread, so engine access stays
         single-threaded."""
         old = self.engine
+        tr = getattr(old, "tracer", None)
+        t_restart = tr.now_us() if tr is not None else 0.0
         # Drop the dead engine's device slabs BEFORE the new pool is
         # allocated: restart peak memory must stay ~one pool, or an
         # HBM-sized production pool would OOM every rebuild and turn a
@@ -434,8 +446,11 @@ class EngineRunner:
         # object; a watchdog-superseded-but-alive thread finishing its
         # slow tick would otherwise keep writing on_token/on_finish into
         # it (engine internals have no gen guard — only the bridge does)
-        # and double-count with the replay below
+        # and double-count with the replay below.  The tracer is muted
+        # the same way: a zombie tick must not interleave stale spans
+        # into the timeline the rebuilt engine now owns.
         old.metrics = ServeMetrics(clock=old.clock)
+        old.tracer = None
         with self._sup_lock:
             if gen != self._gen:
                 # superseded DURING the rebuild (it wedged long enough
@@ -482,7 +497,7 @@ class EngineRunner:
                 engine.recover(
                     rec["prompt"], rec["max_tokens"], request_id=rid,
                     seed=rec["seed"], generated=tokens, callback=cb,
-                    on_event=on_event, deadline_s=rec["deadline_s"],
+                    on_event=on_event, deadline_at=rec["deadline_at"],
                 )
             except Exception as e:  # noqa: BLE001 — per-request fate
                 # a request the REBUILT pool cannot re-admit (should not
@@ -492,6 +507,10 @@ class EngineRunner:
                       file=sys.stderr)
             if gen == self._gen:
                 self._beat = time.monotonic()
+        if tr is not None:
+            tr.complete("restart", t_restart, cat="supervisor", args={
+                "gen": gen, "replayed": len(replay),
+            })
 
     def _on_engine_death(self, reason: str, gen: int) -> None:
         """Crash/hang handler (from the dying thread or the watchdog):
@@ -528,6 +547,11 @@ class EngineRunner:
             replay = [dict(rec, tokens=list(rec["tokens"]))
                       for rec in self._inflight.values()]
             new_gen = self._gen
+        tr = getattr(self.engine, "tracer", None)
+        if tr is not None:
+            tr.instant("engine-death", cat="supervisor", args={
+                "reason": reason, "gen": gen, "restart": new_gen,
+            })
         print(f"[serve] engine death ({reason}); supervised restart "
               f"{len(replay)} in-flight to replay, "
               f"{len(self._recent_deaths)}/{self.max_restarts} deaths in "
@@ -541,6 +565,10 @@ class EngineRunner:
         hanging until their own timeouts), /healthz flips unhealthy, and
         new submits are refused."""
         self.crashed = reason
+        tr = getattr(self.engine, "tracer", None)
+        if tr is not None:
+            tr.instant("engine-terminal-crash", cat="supervisor",
+                       args={"reason": reason})
         # supersede a HUNG (still running) thread too: without the gen
         # bump it would wake and keep ticking — a zombie generation
         # burning the device for already-flushed streams
@@ -673,13 +701,27 @@ class HttpServer:
         await self._done.wait()
 
     # ------------------------------------------------------------------
+    @property
+    def tracer(self) -> Any:
+        """The live engine's trace recorder (rebinds across supervised
+        restarts — the recorder object itself is shared), or None."""
+        return getattr(self.runner.engine, "tracer", None)
+
     async def _on_conn(self, reader: asyncio.StreamReader,
                        writer: asyncio.StreamWriter) -> None:
         task = asyncio.current_task()
         if task is not None:
             self._conn_tasks.add(task)
+        # request spans start AT SOCKET ACCEPT: time spent reading and
+        # parsing the request is part of what the client experiences,
+        # and must be separable from engine queue wait in the trace.
+        # -1 sentinel (engine.step discipline): if the tracer appears
+        # only AFTER accept (the supervised-restart mute window), the
+        # span must not start at the trace epoch
+        tracer = self.tracer
+        t_accept = tracer.now_us() if tracer is not None else -1.0
         try:
-            await self._handle(reader, writer)
+            await self._handle(reader, writer, t_accept)
         except (ConnectionResetError, BrokenPipeError, asyncio.TimeoutError):
             pass
         finally:
@@ -690,7 +732,8 @@ class HttpServer:
                 await writer.wait_closed()
 
     async def _handle(self, reader: asyncio.StreamReader,
-                      writer: asyncio.StreamWriter) -> None:
+                      writer: asyncio.StreamWriter,
+                      t_accept: float = -1.0) -> None:
         try:
             method, path, headers, body = await asyncio.wait_for(
                 self._read_request(reader), timeout=30.0,
@@ -723,12 +766,28 @@ class HttpServer:
                 writer, 200, self._render_metrics().encode(),
                 content_type="text/plain; version=0.0.4; charset=utf-8",
             )
+        elif method == "GET" and path == "/debug/trace":
+            tracer = self.tracer
+            if tracer is None:
+                await self._respond_error(writer, HTTPError(
+                    404, "tracing is off; start the server with "
+                    "--trace-ring N (and/or --trace-out PATH)"))
+            else:
+                # point-in-time ring-buffer snapshot, loadable straight
+                # into ui.perfetto.dev.  Serialized OFF the event loop:
+                # a full ring is hundreds of thousands of dicts, and
+                # json.dumps-ing them inline would stall every live SSE
+                # stream — the instrument must not perturb what it
+                # measures
+                body = await asyncio.get_running_loop().run_in_executor(
+                    None, lambda: json.dumps(tracer.to_dict()).encode())
+                await self._respond(writer, 200, body)
         elif path == "/v1/completions":
             if method != "POST":
                 await self._respond_error(writer, HTTPError(
                     405, "use POST for /v1/completions"))
             else:
-                await self._completions(reader, writer, body)
+                await self._completions(reader, writer, body, t_accept)
         else:
             await self._respond_error(writer, HTTPError(
                 404, f"no route for {method} {path}"))
@@ -789,7 +848,7 @@ class HttpServer:
     # ------------------------------------------------------------------
     async def _completions(self, reader: asyncio.StreamReader,
                            writer: asyncio.StreamWriter,
-                           body: bytes) -> None:
+                           body: bytes, t_accept: float = -1.0) -> None:
         if self.draining or self.runner.crashed:
             msg = ("engine tick thread crashed: " + self.runner.crashed
                    if self.runner.crashed
@@ -824,6 +883,25 @@ class HttpServer:
         loop = asyncio.get_running_loop()
         aq: asyncio.Queue = asyncio.Queue()
         rid = self.runner.next_rid()
+        tracer = self.tracer
+        if tracer is not None:
+            # the http bracket span: accept → response done, enclosing
+            # the engine's queued/prefill/decode spans on the same
+            # track.  t_accept < 0 means the tracer appeared after
+            # accept (restart mute window) — begin at now, not at the
+            # trace epoch
+            tracer.async_begin(rid, "http",
+                               ts_us=t_accept if t_accept >= 0.0 else None,
+                               args={"stream": bool(payload.stream)})
+        try:
+            await self._completions_inner(
+                reader, writer, payload, rid, loop, aq)
+        finally:
+            if tracer is not None:
+                tracer.async_end(rid, "http")
+
+    async def _completions_inner(self, reader, writer, payload, rid,
+                                 loop, aq) -> None:
         self.runner.submit(rid, payload, loop, aq)
         verdict = await aq.get()
         if verdict[0] == "rejected":

@@ -24,8 +24,22 @@ flat dict (``snapshot()``) so the CLI, bench.py, tests, and the HTTP
                              gather path streams the full padded view,
                              the paged kernel only each row's visible
                              blocks)
+- ``queue_wait_s_*`` / ``prefill_s_*`` — per-request phase splits
+                             (submit → first admission; cumulative
+                             prefill dispatch time incl. re-prefills),
+                             derived from the same timestamps that feed
+                             the request spans in serve/tracing.py — so
+                             a scrape answers "queueing or compute?"
+                             without a trace file.
 
 Percentiles are p50/p90/p99 over whatever was recorded — no windowing.
+
+``ttft_s`` and ``decode_tok_s`` additionally maintain REAL Prometheus
+histograms (cumulative ``_bucket``/``_sum``/``_count`` series over the
+fixed ``TTFT_BUCKETS`` / ``DECODE_TOK_S_BUCKETS``): the bucket counters
+are updated incrementally at record time, so they stay exact forever
+even when ``max_samples`` trims the percentile windows — and unlike the
+quantile gauges they aggregate correctly across replicas.
 
 THREAD SAFETY: the engine tick loop mutates these counters from its own
 thread while the HTTP scrape handler renders them from the event loop —
@@ -38,6 +52,7 @@ same snapshot.
 
 from __future__ import annotations
 
+import bisect
 import threading
 import time
 from collections import Counter
@@ -46,6 +61,14 @@ from typing import Any
 import numpy as np
 
 from llm_np_cp_tpu.serve.scheduler import Request
+
+# Fixed histogram buckets (upper bounds, seconds / tokens-per-second).
+# Fixed so series are comparable across runs and joinable across
+# replicas; spans roughly host-CPU test ticks to live-TPU serving.
+TTFT_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
+                0.5, 1.0, 2.5, 5.0, 10.0)
+DECODE_TOK_S_BUCKETS = (0.5, 1.0, 2.0, 5.0, 10.0, 20.0, 50.0, 100.0,
+                        200.0, 500.0, 1000.0)
 
 
 def _pcts(values: list[float], name: str) -> dict[str, float]:
@@ -85,6 +108,17 @@ class ServeMetrics:
         self.finish_reasons: Counter[str] = Counter()
         self.ttft_s: list[float] = []
         self.decode_tok_s: list[float] = []
+        # per-request phase splits (queueing vs compute), recorded at
+        # terminal time from Request.admit_time / Request.prefill_s
+        self.queue_wait_s: list[float] = []
+        self.prefill_s: list[float] = []
+        # exact cumulative histogram state (never trimmed): per-bucket
+        # increments + running sum; bucket i counts values <= bucket[i],
+        # the trailing slot is the +Inf overflow
+        self.ttft_hist = [0] * (len(TTFT_BUCKETS) + 1)
+        self.ttft_hist_sum = 0.0
+        self.decode_hist = [0] * (len(DECODE_TOK_S_BUCKETS) + 1)
+        self.decode_hist_sum = 0.0
         self.queue_depth: list[int] = []
         self.occupancy: list[float] = []
         self.active_slots: list[int] = []
@@ -172,13 +206,27 @@ class ServeMetrics:
             # virtual clock is incommensurable with wall time, so
             # virtual-mode TTFT is based at submit
             base = req.extra.get("arrival_wall", req.submit_time)
-            self.ttft_s.append(req.first_token_time - base)
+            ttft = req.first_token_time - base
+            self.ttft_s.append(ttft)
             self._trim(self.ttft_s)
+            self.ttft_hist[bisect.bisect_left(TTFT_BUCKETS, ttft)] += 1
+            self.ttft_hist_sum += ttft
             n_after_first = len(req.generated) - 1
             span = (req.finish_time or self.clock()) - req.first_token_time
             if n_after_first > 0 and span > 0:
-                self.decode_tok_s.append(n_after_first / span)
+                rate = n_after_first / span
+                self.decode_tok_s.append(rate)
                 self._trim(self.decode_tok_s)
+                self.decode_hist[
+                    bisect.bisect_left(DECODE_TOK_S_BUCKETS, rate)
+                ] += 1
+                self.decode_hist_sum += rate
+        if req.submit_time is not None and req.admit_time is not None:
+            self.queue_wait_s.append(req.admit_time - req.submit_time)
+            self._trim(self.queue_wait_s)
+        if req.prefill_s:
+            self.prefill_s.append(req.prefill_s)
+            self._trim(self.prefill_s)
 
     # ------------------------------------------------------------------
     def snapshot(self) -> dict[str, Any]:
@@ -203,6 +251,8 @@ class ServeMetrics:
             # the tick loop keeps appending
             ttft = list(self.ttft_s)
             decode = list(self.decode_tok_s)
+            qwait = list(self.queue_wait_s)
+            prefill = list(self.prefill_s)
             qd = [float(q) for q in self.queue_depth]
             occ = list(self.occupancy)
             act = [float(a) for a in self.active_slots]
@@ -211,6 +261,8 @@ class ServeMetrics:
             prefix_hit = self.prefix_blocks_hit
         out.update(_pcts(ttft, "ttft_s"))
         out.update(_pcts(decode, "decode_tok_s"))
+        out.update(_pcts(qwait, "queue_wait_s"))
+        out.update(_pcts(prefill, "prefill_s"))
         out.update(_pcts(qd, "queue_depth"))
         out.update(_pcts(occ, "occupancy"))
         out.update(_pcts(act, "active_slots"))
@@ -300,16 +352,57 @@ class ServeMetrics:
         emit("throughput_tok_s", "gauge",
              "Generated tokens per second over the traffic span",
              [("", s["throughput_tok_s"])])
-        ttft = [(f'{{quantile="{q}"}}', s[f"ttft_s_{p}"])
-                for q, p in (("0.5", "p50"), ("0.9", "p90"), ("0.99", "p99"))
-                if f"ttft_s_{p}" in s]
-        if ttft:
-            with self._lock:
-                ttft_sum, ttft_n = sum(self.ttft_s), len(self.ttft_s)
-            emit("ttft_seconds", "summary",
-                 "Submit/arrival to first token, per request", ttft)
-            lines.append(f"{prefix}_ttft_seconds_sum {ttft_sum:.10g}")
-            lines.append(f"{prefix}_ttft_seconds_count {ttft_n}")
+        # -- real histograms: cumulative _bucket/_sum/_count from the
+        # incrementally-maintained counters (exact forever, unlike the
+        # trimmed percentile windows; aggregable across replicas)
+        with self._lock:
+            ttft_hist = list(self.ttft_hist)
+            ttft_hist_sum = self.ttft_hist_sum
+            decode_hist = list(self.decode_hist)
+            decode_hist_sum = self.decode_hist_sum
+
+        def emit_hist(name: str, help_: str, buckets: tuple,
+                      counts: list[int], total: float) -> None:
+            full = f"{prefix}_{name}"
+            lines.append(f"# HELP {full} {help_}")
+            lines.append(f"# TYPE {full} histogram")
+            cum = 0
+            for le, n in zip(buckets, counts):
+                cum += n
+                lines.append(f'{full}_bucket{{le="{le:.10g}"}} {cum}')
+            cum += counts[-1]
+            lines.append(f'{full}_bucket{{le="+Inf"}} {cum}')
+            lines.append(f"{full}_sum {total:.10g}")
+            lines.append(f"{full}_count {cum}")
+
+        emit_hist("ttft_seconds",
+                  "Submit/arrival to first token, per request",
+                  TTFT_BUCKETS, ttft_hist, ttft_hist_sum)
+        emit_hist("decode_tok_s",
+                  "Per-request steady decode rate (tokens after the "
+                  "first / time after first token)",
+                  DECODE_TOK_S_BUCKETS, decode_hist, decode_hist_sum)
+
+        # -- trace-wide quantile gauges alongside the histograms (the
+        # single-process view; percentile windows, see max_samples) and
+        # the per-request phase split — "queueing or compute?" straight
+        # off the scrape, no trace file needed
+        for base, help_ in (
+            ("ttft_s", "TTFT quantiles over the recorded window"),
+            ("decode_tok_s",
+             "Decode-rate quantiles over the recorded window"),
+            ("queue_wait_s",
+             "Submit to first admission into a decode slot, per request"),
+            ("prefill_s",
+             "Cumulative prefill dispatch time per request "
+             "(re-prefills after preemption/recovery included)"),
+        ):
+            samples = [(f'{{quantile="{q}"}}', s[f"{base}_{p}"])
+                       for q, p in (("0.5", "p50"), ("0.9", "p90"),
+                                    ("0.99", "p99"))
+                       if f"{base}_{p}" in s]
+            if samples:
+                emit(f"{base}_quantile", "gauge", help_, samples)
         for key, value in (extra_gauges or {}).items():
             emit(key, "gauge", "Live server gauge", [("", float(value))])
         return "\n".join(lines) + "\n"
@@ -343,6 +436,10 @@ class ServeMetrics:
             f"({s['total_generated_tokens']} tokens in {s['wall_s']:.2f}s)\n"
             f"ttft_s      p50 {g('ttft_s_p50')}  p90 {g('ttft_s_p90')}  "
             f"p99 {g('ttft_s_p99')}\n"
+            f"queue_wait_s p50 {g('queue_wait_s_p50')}  "
+            f"p99 {g('queue_wait_s_p99')}; "
+            f"prefill_s p50 {g('prefill_s_p50')}  "
+            f"p99 {g('prefill_s_p99')}\n"
             f"decode_tok_s p50 {g('decode_tok_s_p50', '{:.1f}')}  "
             f"p90 {g('decode_tok_s_p90', '{:.1f}')}\n"
             f"queue_depth p50 {g('queue_depth_p50', '{:.1f}')}  "

@@ -105,6 +105,13 @@ class Request:
     n_preemptions: int = 0
     # -- metrics timestamps -------------------------------------------
     submit_time: float | None = None
+    # first admission into a decode slot (queue_wait_s = admit_time -
+    # submit_time; preemption requeues keep the FIRST admission — the
+    # user-visible wait ended when work first started)
+    admit_time: float | None = None
+    # cumulative wall time spent in prefill dispatch for this request
+    # (re-prefills after preemption/recovery add to it)
+    prefill_s: float = 0.0
     first_token_time: float | None = None
     finish_time: float | None = None
     extra: dict[str, Any] = dataclasses.field(default_factory=dict)

@@ -1,0 +1,260 @@
+"""Request-lifecycle + tick-phase tracing for the serving stack.
+
+``ServeMetrics`` answers *how much* (counters, percentiles); this module
+answers *where the time went*: when a p99 TTFT regresses or a chaos run
+recovers slowly, the operator needs a timeline — queue wait vs prefill
+chunks vs decode dispatch vs host sync vs SSE delivery — not another
+percentile.  ``TraceRecorder`` collects that timeline as Chrome/Perfetto
+trace-event JSON (stdlib only, like the rest of the HTTP stack; open the
+dump at ui.perfetto.dev or chrome://tracing):
+
+- **per-request spans** — async events (``ph`` b/e/n) on one track per
+  request id: ``queued`` → ``prefill`` (prefix-cache hits annotated,
+  one ``prefill_chunk`` slice per dispatched chunk) → ``decode`` →
+  a terminal ``finish`` instant (reason-tagged), with instants for
+  ``evicted-requeued`` preemptions and ``recovery-replay`` resubmits
+  after a supervised restart.  The HTTP layer brackets the whole thing
+  with an ``http`` span starting at socket accept, so queue wait is
+  visibly split from network/parse time.
+- **per-tick phase spans** — complete events (``ph`` X) on the engine
+  tick thread: ``admission`` / ``prefill`` / ``grow`` /
+  ``decode_dispatch`` / ``host_sync`` / ``deliver`` slices nested under
+  one ``tick`` event.  The phases are measured at consecutive
+  timestamps, so they sum to the tick span by construction — the
+  invariant tests pin.
+- the dispatch phases also run under ``jax.profiler.TraceAnnotation``
+  named scopes, so this host timeline lines up against a device profile
+  captured with ``--jax-profile DIR`` (the live-TPU tuning workflow).
+
+ZERO-OVERHEAD WHEN OFF (the ``FaultInjector`` discipline): nothing
+constructs a recorder unless tracing is requested (``--trace-out`` /
+``--trace-ring``), and every hook in the engine/HTTP hot path is a
+single ``is None`` check — no allocation, no call.  Pinned by
+``tools/compile_counter.assert_tracing_hooks_guarded`` (an AST lint over
+the hot-path modules) plus a zero-new-compiles test.
+
+THREAD SAFETY: events arrive from the engine tick thread, the asyncio
+event loop, the watchdog, and the supervisor's rebuild thread — one lock
+serializes every append, and readers (``events()`` / ``to_dict()`` /
+the ``GET /debug/trace`` handler) copy under it.  With ``ring=N`` the
+recorder keeps only the newest N events (a long-running server must not
+grow without bound); ``dropped`` counts what the ring displaced.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from collections import deque
+from typing import Any, Callable
+
+# The request-lifecycle phase names, in order; ``request_phase``
+# transitions between them (ending whatever span is open) and
+# ``request_end`` closes the track with a reason-tagged ``finish``
+# instant.  tools/summarize_trace.py renders these (plus the HTTP
+# layer's "http" bracket) as its lifecycle columns — that tool stays
+# stdlib-only, so it carries its own copy, pinned equal to this one by
+# tests/test_serve_tracing.py.
+REQUEST_PHASES = ("queued", "prefill", "decode")
+# Tick-phase names, in tick order (see ServeEngine.step).
+TICK_PHASES = (
+    "admission", "prefill", "grow", "decode_dispatch", "host_sync",
+    "deliver",
+)
+
+
+class TraceRecorder:
+    def __init__(
+        self,
+        *,
+        clock: Callable[[], float] = time.perf_counter,
+        ring: int | None = None,
+    ) -> None:
+        if ring is not None and ring < 1:
+            raise ValueError(f"ring must be >= 1 or None, got {ring}")
+        self.clock = clock
+        self.ring = ring
+        self._t0 = clock()
+        self._pid = os.getpid()
+        self._lock = threading.Lock()
+        self._events: deque | list = (
+            deque(maxlen=ring) if ring is not None else []
+        )
+        self.dropped = 0
+        # rid → currently-open lifecycle phase name (exactly one per
+        # live request; the http bracket span is tracked separately by
+        # async_begin/async_end)
+        self._req_phase: dict[int, str] = {}
+        self._named_threads: set[int] = set()
+
+    # -- clock ---------------------------------------------------------
+    def now_us(self) -> float:
+        """Microseconds since recorder construction (the trace epoch)."""
+        return (self.clock() - self._t0) * 1e6
+
+    # -- low-level event append (callers hold no lock) -----------------
+    def _ensure_thread_named(self, tid: int) -> None:
+        # caller holds the lock; first event from a thread gets the
+        # thread_name metadata event viewers use to label its track
+        if tid not in self._named_threads:
+            self._named_threads.add(tid)
+            self._push({
+                "name": "thread_name", "ph": "M", "pid": self._pid,
+                "tid": tid,
+                "args": {"name": threading.current_thread().name},
+            })
+
+    def _append(self, ev: dict, tid: int | None = None) -> None:
+        tid = threading.get_ident() if tid is None else tid
+        ev.setdefault("pid", self._pid)
+        ev.setdefault("tid", tid)
+        with self._lock:
+            self._ensure_thread_named(tid)
+            self._push(ev)
+
+    def _push(self, ev: dict) -> None:
+        # caller holds the lock
+        if self.ring is not None and len(self._events) == self.ring:
+            self.dropped += 1
+        self._events.append(ev)
+
+    # -- synchronous (thread-track) events -----------------------------
+    def complete(
+        self, name: str, start_us: float, end_us: float | None = None,
+        *, cat: str = "phase", args: dict | None = None,
+    ) -> None:
+        """One ``ph: X`` slice on the calling thread's track."""
+        if end_us is None:
+            end_us = self.now_us()
+        ev: dict[str, Any] = {
+            "name": name, "cat": cat, "ph": "X",
+            "ts": start_us, "dur": max(end_us - start_us, 0.0),
+        }
+        if args:
+            ev["args"] = args
+        self._append(ev)
+
+    def instant(self, name: str, *, cat: str = "tick",
+                args: dict | None = None) -> None:
+        ev: dict[str, Any] = {
+            "name": name, "cat": cat, "ph": "i", "ts": self.now_us(),
+            "s": "t",
+        }
+        if args:
+            ev["args"] = args
+        self._append(ev)
+
+    def tick(
+        self, start_us: float,
+        phases: tuple[tuple[str, float, float], ...],
+        *, args: dict | None = None,
+    ) -> None:
+        """One tick: the wrapper ``tick`` slice plus its phase slices,
+        appended atomically (a ``/debug/trace`` read never sees a tick
+        missing half its phases).  Phases are ``(name, t0_us, t1_us)``
+        measured at consecutive timestamps, so their durations sum to
+        the tick span by construction."""
+        end_us = self.now_us()
+        tid = threading.get_ident()
+        events = [{
+            "name": "tick", "cat": "tick", "ph": "X", "ts": start_us,
+            "dur": max(end_us - start_us, 0.0), "pid": self._pid,
+            "tid": tid, **({"args": args} if args else {}),
+        }]
+        for name, p0, p1 in phases:
+            events.append({
+                "name": name, "cat": "phase", "ph": "X", "ts": p0,
+                "dur": max(p1 - p0, 0.0), "pid": self._pid, "tid": tid,
+            })
+        with self._lock:
+            self._ensure_thread_named(tid)
+            for ev in events:
+                self._push(ev)
+
+    # -- request-lifecycle (async-track) events ------------------------
+    def async_begin(self, rid: int, name: str, *,
+                    ts_us: float | None = None,
+                    args: dict | None = None) -> None:
+        ev: dict[str, Any] = {
+            "name": name, "cat": "request", "ph": "b", "id": rid,
+            "ts": self.now_us() if ts_us is None else ts_us,
+        }
+        if args:
+            ev["args"] = args
+        self._append(ev)
+
+    def async_end(self, rid: int, name: str, *,
+                  ts_us: float | None = None) -> None:
+        self._append({
+            "name": name, "cat": "request", "ph": "e", "id": rid,
+            "ts": self.now_us() if ts_us is None else ts_us,
+        })
+
+    def request_phase(self, rid: int, phase: str, *,
+                      args: dict | None = None) -> None:
+        """Transition request ``rid`` into ``phase``: end whatever
+        lifecycle span is open and begin the new one (back-to-back, one
+        timestamp — no gap, no overlap)."""
+        now = self.now_us()
+        with self._lock:
+            open_phase = self._req_phase.get(rid)
+            self._req_phase[rid] = phase
+        if open_phase is not None:
+            self.async_end(rid, open_phase, ts_us=now)
+        self.async_begin(rid, phase, ts_us=now, args=args)
+
+    def request_instant(self, rid: int, name: str, *,
+                        args: dict | None = None) -> None:
+        """Async instant (``ph: n``) on the request's track —
+        annotations like ``evicted-requeued`` / ``recovery-replay``."""
+        ev: dict[str, Any] = {
+            "name": name, "cat": "request", "ph": "n", "id": rid,
+            "ts": self.now_us(),
+        }
+        if args:
+            ev["args"] = args
+        self._append(ev)
+
+    def request_end(self, rid: int, reason: str, *,
+                    args: dict | None = None) -> None:
+        """Terminal: close the open lifecycle span and stamp a
+        reason-tagged ``finish`` instant (span-vs-metrics parity counts
+        these against the finish_reasons counters)."""
+        now = self.now_us()
+        with self._lock:
+            open_phase = self._req_phase.pop(rid, None)
+        if open_phase is not None:
+            self.async_end(rid, open_phase, ts_us=now)
+        merged = {"reason": reason}
+        if args:
+            merged.update(args)
+        self._append({
+            "name": "finish", "cat": "request", "ph": "n", "id": rid,
+            "ts": now, "args": merged,
+        })
+
+    # -- export --------------------------------------------------------
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._events)
+
+    def events(self) -> list[dict]:
+        """Point-in-time copy (the ring keeps mutating underneath)."""
+        with self._lock:
+            return list(self._events)
+
+    def to_dict(self) -> dict:
+        return {
+            "traceEvents": self.events(),
+            "displayTimeUnit": "ms",
+            "otherData": {"dropped_events": self.dropped},
+        }
+
+    def dump(self, path: str) -> int:
+        """Write the Chrome trace-event JSON; returns the event count."""
+        payload = self.to_dict()
+        with open(path, "w") as f:
+            json.dump(payload, f)
+        return len(payload["traceEvents"])

@@ -449,6 +449,46 @@ def test_cli_serve_bench_paged_and_prefix_cache(fake_load, capsys):
     assert float(m.group(1)) > 0, out
 
 
+def test_cli_serve_bench_trace_out_writes_valid_trace(fake_load, capsys,
+                                                      tmp_path):
+    """--trace-out: the replay records request spans + tick phases and
+    dumps Chrome trace-event JSON that tools/summarize_trace.py can
+    digest end to end; --trace-ring must be non-negative."""
+    import json
+
+    from tools.summarize_trace import format_summary, load_trace
+
+    path = tmp_path / "bench_trace.json"
+    cli.run([
+        "serve-bench", "--requests=4", "--rate=50", "--prompt-len=8",
+        "--max-tokens=3", "--slots=2", "--block-size=8", "--seed=1",
+        f"--trace-out={path}",
+    ])
+    printed = capsys.readouterr().out
+    assert "tracing ACTIVE" in printed
+    assert "trace events" in printed
+    events = load_trace(str(path))
+    assert any(e.get("cat") == "tick" for e in events)
+    finishes = [e for e in events
+                if e.get("cat") == "request" and e.get("ph") == "n"
+                and e["name"] == "finish"]
+    assert len(finishes) == 4  # warmup's dummy request is NOT in there
+    out = format_summary(events)
+    assert "decode_dispatch" in out
+    # ring-bounded mode caps the buffer
+    path2 = tmp_path / "ring_trace.json"
+    cli.run([
+        "serve-bench", "--requests=4", "--rate=50", "--prompt-len=8",
+        "--max-tokens=3", "--slots=2", "--block-size=8", "--seed=1",
+        f"--trace-out={path2}", "--trace-ring=20",
+    ])
+    ring = json.loads(path2.read_text())
+    assert len(ring["traceEvents"]) <= 20
+    assert ring["otherData"]["dropped_events"] > 0
+    with pytest.raises(SystemExit, match="trace-ring"):
+        cli.run(["serve-bench", "--trace-ring=-1"])
+
+
 def test_cli_serve_bench_rejects_paged_when_probe_fails(fake_load, monkeypatch):
     """An EXPLICIT --attn-impl paged must die with an actionable message
     when Mosaic rejects the kernel — not a Pallas traceback; auto falls

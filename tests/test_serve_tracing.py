@@ -1,0 +1,591 @@
+"""Request-lifecycle tracing + tick-phase profiling (serve/tracing.py).
+
+The tracing subsystem is only trustworthy if (a) every emitted event is
+valid Chrome trace-event JSON that nests correctly, (b) the spans agree
+with the metrics counters they shadow (a trace that disagrees with
+/metrics is worse than no trace), and (c) turning tracing OFF costs
+nothing — no recompiles, no hot-path allocations (the FaultInjector
+is-None discipline, pinned by an AST lint).  The deadline-resume fix
+for recovered requests and the Prometheus histogram promotion ride
+along, plus tools/summarize_trace.py against a freshly recorded
+fixture.
+"""
+
+import json
+import re
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+sys.path.insert(0, __file__.rsplit("/tests/", 1)[0])
+from llm_np_cp_tpu.config import tiny_config
+from llm_np_cp_tpu.models.transformer import init_params
+from llm_np_cp_tpu.ops.sampling import Sampler
+from llm_np_cp_tpu.serve import ServeEngine, TraceRecorder, poisson_trace
+from llm_np_cp_tpu.serve.tracing import TICK_PHASES
+from tools.compile_counter import (
+    CompileCounter,
+    assert_tracing_hooks_guarded,
+)
+from tools.summarize_trace import (
+    format_summary,
+    load_trace,
+    phase_totals,
+    request_table,
+    slowest_ticks,
+    tick_stats,
+)
+
+PROM_LINE = re.compile(
+    r"[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^}]*\})? -?[0-9.]+(e[+-]?[0-9]+)?"
+)
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    cfg = tiny_config("llama")
+    params = init_params(jax.random.PRNGKey(0), cfg, dtype=jnp.float32)
+    return cfg, params
+
+
+def _engine(cfg, params, **kw):
+    kw.setdefault("max_slots", 2)
+    kw.setdefault("num_blocks", 32)
+    kw.setdefault("block_size", 8)
+    kw.setdefault("max_seq_len", 64)
+    kw.setdefault("cache_dtype", jnp.float32)
+    return ServeEngine(params, cfg, sampler=Sampler(kind="greedy"), **kw)
+
+
+@pytest.fixture(scope="module")
+def traced_run(tiny):
+    """One traced 8-request Poisson replay shared by the schema /
+    coverage / summarize / histogram tests (each reads, none mutates)."""
+    cfg, params = tiny
+    tracer = TraceRecorder()
+    engine = _engine(cfg, params, tracer=tracer)
+    rng = np.random.default_rng(0)
+    trace = poisson_trace(rng, 8, rate_rps=50.0, prompt_len_range=(3, 10),
+                          max_new_tokens=5, vocab_size=cfg.vocab_size)
+    snap = engine.replay_trace(trace)
+    assert snap["finished"] == 8
+    return engine, tracer, tracer.events()
+
+
+# ---------------------------------------------------------------------------
+# Trace schema: every event parses and nests
+# ---------------------------------------------------------------------------
+
+def test_trace_schema_validates_and_nests(traced_run, tmp_path):
+    _, tracer, events = traced_run
+    assert events, "traced replay recorded nothing"
+    # the dump is loadable JSON in the Chrome wrapper shape
+    path = tmp_path / "trace.json"
+    tracer.dump(str(path))
+    loaded = json.loads(path.read_text())
+    assert isinstance(loaded["traceEvents"], list)
+    assert len(loaded["traceEvents"]) == len(events)
+
+    open_async: dict[tuple, float] = {}
+    for ev in events:
+        assert isinstance(ev["name"], str) and ev["name"]
+        assert ev["ph"] in ("X", "i", "b", "e", "n", "M"), ev
+        if ev["ph"] == "M":
+            continue
+        assert ev["ts"] >= 0.0, ev
+        assert "pid" in ev and "tid" in ev
+        if ev["ph"] == "X":
+            assert ev["dur"] >= 0.0, ev
+        elif ev["ph"] in ("b", "e", "n"):
+            assert ev["cat"] == "request" and "id" in ev
+            key = (ev["id"], ev["name"])
+            if ev["ph"] == "b":
+                assert key not in open_async, f"double-begin {key}"
+                open_async[key] = ev["ts"]
+            elif ev["ph"] == "e":
+                t0 = open_async.pop(key, None)
+                assert t0 is not None, f"end without begin {key}"
+                assert ev["ts"] >= t0
+    assert not open_async, f"unbalanced async spans: {open_async}"
+
+    # every request walked queued → prefill → decode → finish
+    table = request_table(events)
+    assert len(table) == 8
+    for rid, rec in table.items():
+        assert rec["finish"] == "length", (rid, rec)
+        for phase in ("queued", "prefill", "decode"):
+            assert phase in rec["phases_us"], (rid, rec)
+
+
+def test_tick_phase_spans_cover_tick_time(traced_run):
+    """The acceptance invariant: tick-phase spans sum to within 10% of
+    the wall tick time (they are measured at consecutive timestamps, so
+    only the final event-emission tail is outside them).  Asserted on
+    ticks above a jitter floor — a 50µs idle tick can be half timer
+    noise."""
+    _, _, events = traced_run
+    checked = 0
+    i = 0
+    while i < len(events):
+        ev = events[i]
+        i += 1
+        if ev.get("cat") != "tick" or ev.get("ph") != "X":
+            continue
+        # the recorder appends a tick's phase slices atomically after it
+        phases = events[i:i + len(TICK_PHASES)]
+        i += len(TICK_PHASES)
+        assert [p["name"] for p in phases] == list(TICK_PHASES)
+        for p in phases:
+            assert p["ts"] >= ev["ts"] - 1e-6
+            assert p["ts"] + p["dur"] <= ev["ts"] + ev["dur"] + 1e-6
+        if ev["dur"] >= 200.0:  # µs
+            cover = sum(p["dur"] for p in phases) / ev["dur"]
+            assert cover >= 0.9, (
+                f"phases cover {cover:.1%} of a {ev['dur']:.0f}us tick"
+            )
+            checked += 1
+    assert checked > 0, "no tick exceeded the jitter floor — bad workload"
+
+
+# ---------------------------------------------------------------------------
+# Span-vs-metrics parity: the trace must agree with /metrics
+# ---------------------------------------------------------------------------
+
+def test_span_metrics_parity_32_requests_abort_evict_recover(tiny):
+    """32-request Poisson trace through a pool tight enough to preempt,
+    plus a deadline abort and a mid-flight engine rebuild with recovery
+    replays: the span counts in the trace equal the finish-reason /
+    preemption / recovery counters in the metrics snapshot."""
+    cfg, params = tiny
+    tracer = TraceRecorder()
+    engine = _engine(cfg, params, num_blocks=6, tracer=tracer)
+    rng = np.random.default_rng(5)
+    trace = poisson_trace(rng, 32, rate_rps=60.0, prompt_len_range=(3, 6),
+                          max_new_tokens=12, vocab_size=cfg.vocab_size)
+    # one request doomed by its deadline: swept (aborted) on tick 1
+    engine.submit(rng.integers(1, cfg.vocab_size, size=4), 12,
+                  deadline_s=1e-6)
+    snap = engine.replay_trace(trace)
+    assert snap["finished"] == 32
+    assert snap["aborted"] == 1
+    preempts = engine.scheduler.n_preemptions
+    assert preempts > 0, "pool was not tight enough to exercise eviction"
+
+    # crash mid-flight: rebuild + teacher-forced recovery (the
+    # supervisor path, minus the HTTP machinery)
+    live = [engine.submit(rng.integers(1, cfg.vocab_size, size=4), 8,
+                          seed=90 + i) for i in range(3)]
+    for _ in range(3):
+        engine.step()
+    rebuilt = engine.clone_fresh()
+    assert rebuilt.tracer is tracer  # the timeline survives the rebuild
+    for r in live:
+        rebuilt.recover(r.prompt, r.max_new_tokens, request_id=r.req_id,
+                        seed=r.seed, generated=list(r.generated))
+    rebuilt.run_until_complete()
+    preempts += rebuilt.scheduler.n_preemptions
+
+    final = rebuilt.metrics.snapshot()
+    events = tracer.events()
+    finishes = [ev for ev in events
+                if ev.get("cat") == "request" and ev["ph"] == "n"
+                and ev["name"] == "finish"]
+    by_reason: dict[str, int] = {}
+    for ev in finishes:
+        r = ev["args"]["reason"]
+        by_reason[r] = by_reason.get(r, 0) + 1
+    assert by_reason == final["finish_reasons"], (
+        f"span finishes {by_reason} != counters {final['finish_reasons']}"
+    )
+    evicts = sum(1 for ev in events
+                 if ev.get("cat") == "request" and ev["ph"] == "n"
+                 and ev["name"] == "evicted-requeued")
+    assert evicts == preempts
+    recovers = sum(1 for ev in events
+                   if ev.get("cat") == "request" and ev["ph"] == "n"
+                   and ev["name"] == "recovery-replay")
+    assert recovers == final["recovered"] == 3
+
+
+# ---------------------------------------------------------------------------
+# Tracing OFF: zero recompiles, zero hot-path work (lint-pinned)
+# ---------------------------------------------------------------------------
+
+def test_tracing_off_and_on_add_zero_recompiles(tiny):
+    cfg, params = tiny
+    engine = _engine(cfg, params)
+    assert engine.tracer is None  # the default IS off
+    rng = np.random.default_rng(2)
+    prompts = [rng.integers(1, cfg.vocab_size, size=n) for n in (5, 9)]
+    for p in prompts:
+        engine.submit(p, 4)
+    engine.run_until_complete()  # compile everything once
+
+    counter = CompileCounter()
+    with counter.watch():
+        for p in prompts:
+            engine.submit(p, 4)
+        engine.run_until_complete()
+    assert counter.count == 0, f"untraced ticks compiled: {counter.events}"
+
+    # attaching a tracer is host-side only: the step jaxprs cannot see
+    # it, so it must not trigger a single new compile either
+    engine.tracer = TraceRecorder()
+    with counter.watch():
+        for p in prompts:
+            engine.submit(p, 4)
+        engine.run_until_complete()
+    assert counter.count == 0, f"traced ticks compiled: {counter.events}"
+    assert len(engine.tracer) > 0
+    engine.tracer = None
+
+
+def test_tracing_hooks_guarded_lint_and_detects_violations(tmp_path):
+    """The hot-path modules pass the is-None discipline lint — and the
+    lint actually bites: an unguarded tracer call in a synthetic module
+    fails it (a lint that cannot fail pins nothing)."""
+    assert_tracing_hooks_guarded()
+
+    bad = tmp_path / "bad_hot_path.py"
+    bad.write_text(
+        "class Engine:\n"
+        "    def step(self):\n"
+        "        tr = self.tracer\n"
+        "        tr.instant('tick')  # no is-None guard\n"
+    )
+    with pytest.raises(AssertionError, match="without an"):
+        assert_tracing_hooks_guarded((str(bad),))
+    direct = tmp_path / "bad_direct.py"
+    direct.write_text(
+        "class Engine:\n"
+        "    def step(self):\n"
+        "        self.tracer.instant('tick')  # unguarded attribute call\n"
+    )
+    with pytest.raises(AssertionError, match="without an"):
+        assert_tracing_hooks_guarded((str(direct),))
+
+
+# ---------------------------------------------------------------------------
+# Deadline resume on recovery (the ROADMAP follow-up fix)
+# ---------------------------------------------------------------------------
+
+def test_recover_resumes_remaining_deadline_budget(tiny):
+    """A recovered request keeps its ORIGINAL absolute deadline
+    (deadline_at) instead of being granted a fresh window — and one
+    whose budget ran out while the engine was down is swept on the first
+    tick, exactly as if the engine had lived."""
+    cfg, params = tiny
+    now = [100.0]
+    engine = _engine(cfg, params, clock=lambda: now[0])
+    req = engine.submit(np.asarray([3, 5, 7], np.int32), 8, deadline_s=5.0)
+    assert req.deadline == 105.0
+    engine.step()  # mid-flight
+    assert 0 < len(req.generated) < 8
+
+    rebuilt = engine.clone_fresh()
+    with pytest.raises(ValueError, match="not both"):
+        rebuilt.recover(req.prompt, 8, request_id=req.req_id,
+                        generated=list(req.generated),
+                        deadline_s=5.0, deadline_at=req.deadline)
+    rec = rebuilt.recover(req.prompt, 8, request_id=req.req_id,
+                          seed=req.seed, generated=list(req.generated),
+                          deadline_at=req.deadline)
+    assert rec.deadline == 105.0, "recovery must not restart the window"
+
+    # 3 virtual seconds of downtime already elapsed; 2 remain — still
+    # live now, swept once the remaining budget runs out
+    now[0] = 103.0
+    rebuilt.step()
+    assert rec.state.value in ("queued", "running")
+    now[0] = 105.5
+    rebuilt.step()
+    assert rec.finish_reason == "aborted"
+    assert rebuilt.metrics.snapshot()["finish_reasons"]["aborted"] == 1
+
+
+def test_runner_ledger_records_absolute_deadline(tiny):
+    """The EngineRunner's replay ledger stores deadline_at (the absolute
+    deadline on the engine clock), which is what _rebuild_and_replay
+    hands to recover — the end-to-end wiring of the fix."""
+    from llm_np_cp_tpu.serve.http.server import EngineRunner
+
+    cfg, params = tiny
+    engine = _engine(cfg, params)
+    runner = EngineRunner(engine, request_timeout=4.0)
+
+    class Payload:
+        prompt_ids = np.asarray([2, 4], np.int32)
+        max_tokens = 4
+        seed = 0
+        timeout_s = None
+        stream = False
+
+    rid = runner.next_rid()
+    runner._exec_inner(("submit", rid, Payload()), 0)
+    rec = runner._inflight[rid]
+    assert rec["deadline_at"] == engine._requests[rid].deadline
+    assert rec["deadline_at"] is not None
+
+
+# ---------------------------------------------------------------------------
+# summarize_trace tool against a recorded fixture
+# ---------------------------------------------------------------------------
+
+def test_summarize_vocabulary_matches_recorder():
+    """summarize_trace.py stays stdlib-only, so it carries its own copy
+    of the lifecycle phase names — pinned equal to the recorder's here
+    (plus the HTTP bracket span)."""
+    from llm_np_cp_tpu.serve.tracing import REQUEST_PHASES
+    from tools.summarize_trace import LIFECYCLE_COLUMNS
+
+    assert LIFECYCLE_COLUMNS == REQUEST_PHASES + ("http",)
+
+
+def test_summarize_trace_tool(traced_run, tmp_path):
+    _, tracer, events = traced_run
+    path = tmp_path / "fixture_trace.json"
+    tracer.dump(str(path))
+    loaded = load_trace(str(path))
+    assert len(loaded) == len(events)
+
+    totals = phase_totals(loaded)
+    for phase in TICK_PHASES:
+        assert phase in totals, f"missing phase {phase}"
+        assert totals[phase]["count"] > 0
+    assert "prefill_chunk" in totals
+
+    stats = tick_stats(loaded)
+    assert stats["ticks"] > 0
+    assert 0.5 <= stats["phase_coverage"] <= 1.0 + 1e-9
+
+    slow = slowest_ticks(loaded, 3)
+    assert len(slow) == 3
+    assert slow[0]["dur"] >= slow[-1]["dur"]
+
+    table = request_table(loaded)
+    assert len(table) == 8
+    out = format_summary(loaded, top=3)
+    assert "tick phases" in out and "requests" in out
+    assert "decode_dispatch" in out
+    assert "length" in out  # finish reasons rendered
+    # bare-list form loads too (both are valid Chrome trace JSON)
+    bare = tmp_path / "bare.json"
+    bare.write_text(json.dumps(loaded))
+    assert len(load_trace(str(bare))) == len(loaded)
+
+
+# ---------------------------------------------------------------------------
+# Prometheus histograms + phase metrics (the scrape answers
+# "queueing or compute?" without a trace file)
+# ---------------------------------------------------------------------------
+
+def test_prometheus_histograms_and_phase_metrics(traced_run):
+    engine, _, _ = traced_run
+    m = engine.metrics
+    prom = m.prometheus()
+    for line in prom.splitlines():
+        assert line.startswith("# ") or PROM_LINE.fullmatch(line), line
+
+    def buckets(name):
+        pairs = re.findall(
+            rf'^llm_serve_{name}_bucket{{le="([^"]+)"}} (\d+)$', prom, re.M)
+        assert pairs, f"no {name} histogram in scrape"
+        return pairs
+
+    for name, values in (("ttft_seconds", m.ttft_s),
+                         ("decode_tok_s", m.decode_tok_s)):
+        pairs = buckets(name)
+        counts = [int(c) for _, c in pairs]
+        assert counts == sorted(counts), f"{name} buckets not cumulative"
+        assert pairs[-1][0] == "+Inf"
+        n = int(re.search(rf"^llm_serve_{name}_count (\d+)$",
+                          prom, re.M).group(1))
+        assert counts[-1] == n == len(values)
+        total = float(re.search(rf"^llm_serve_{name}_sum (\S+)$",
+                                prom, re.M).group(1))
+        assert total == pytest.approx(sum(values), rel=1e-6)
+        # cumulative bucket counts agree with the recorded samples
+        for le_s, cum in pairs[:-1]:
+            le = float(le_s)
+            assert int(cum) == sum(1 for v in values if v <= le), (
+                f"{name} bucket le={le_s} disagrees with samples"
+            )
+
+    # phase quantile gauges: queueing vs compute straight off the scrape
+    for name in ("queue_wait_s_quantile", "prefill_s_quantile",
+                 "ttft_s_quantile", "decode_tok_s_quantile"):
+        assert re.search(rf'^llm_serve_{name}{{quantile="0.5"}} ', prom,
+                         re.M), f"missing {name}"
+    snap = m.snapshot()
+    assert snap["queue_wait_s_p50"] >= 0.0
+    assert snap["prefill_s_p50"] > 0.0
+
+
+@pytest.mark.http
+def test_debug_trace_endpoint(tiny):
+    """GET /debug/trace serves the live ring buffer as Chrome trace JSON
+    (incl. the http bracket span that starts at socket accept) when
+    tracing is on, and 404s with an actionable message when off."""
+    import asyncio
+
+    from llm_np_cp_tpu.serve.http.client import http_get, post_completion
+    from llm_np_cp_tpu.serve.http.server import HttpServer
+
+    cfg, params = tiny
+    tracer = TraceRecorder(ring=5000)
+    engine = _engine(cfg, params, tracer=tracer)
+
+    async def main():
+        srv = HttpServer(engine, model_id="tiny", drain_timeout=10.0)
+        await srv.start("127.0.0.1", 0)
+        host, port = srv.host, srv.port
+        loop = asyncio.get_running_loop()
+        st, obj = await loop.run_in_executor(
+            None, post_completion, host, port,
+            {"prompt": [4, 2, 9], "max_tokens": 3})
+        assert st == 200
+        st, body = await loop.run_in_executor(
+            None, http_get, host, port, "/debug/trace")
+        assert st == 200
+        dump = json.loads(body)
+        srv.begin_drain()
+        await srv.serve_until_shutdown()
+        return dump
+
+    dump = asyncio.run(asyncio.wait_for(main(), timeout=120))
+    events = dump["traceEvents"]
+    names = {(e.get("cat"), e["name"], e["ph"]) for e in events}
+    assert ("request", "http", "b") in names  # span starts at accept
+    assert ("request", "queued", "b") in names
+    assert ("tick", "tick", "X") in names
+    # the http span opened BEFORE the engine saw the request
+    t_http = min(e["ts"] for e in events
+                 if e.get("cat") == "request" and e["name"] == "http"
+                 and e["ph"] == "b")
+    t_queued = min(e["ts"] for e in events
+                   if e.get("cat") == "request" and e["name"] == "queued"
+                   and e["ph"] == "b")
+    assert t_http <= t_queued
+
+    # tracing off → 404 with the how-to-enable hint
+    engine_off = _engine(cfg, params)
+
+    async def main_off():
+        srv = HttpServer(engine_off, model_id="tiny", drain_timeout=10.0)
+        await srv.start("127.0.0.1", 0)
+        loop = asyncio.get_running_loop()
+        st, body = await loop.run_in_executor(
+            None, http_get, srv.host, srv.port, "/debug/trace")
+        srv.begin_drain()
+        await srv.serve_until_shutdown()
+        return st, body
+
+    st, body = asyncio.run(asyncio.wait_for(main_off(), timeout=120))
+    assert st == 404 and b"--trace-ring" in body
+
+
+@pytest.mark.http
+@pytest.mark.chaos
+def test_traced_chaos_poisson_covers_recovery(tiny):
+    """The acceptance run: a 32-request Poisson workload over HTTP with
+    a seeded tick-crash and tracing on — every request completes, the
+    trace covers every request INCLUDING the recovery replays (finish
+    instants == finished count, ≥1 recovery-replay span, a supervisor
+    restart span), the tick phases keep their coverage invariant, and
+    the dump is valid trace-event JSON end to end."""
+    import asyncio
+
+    from llm_np_cp_tpu.serve import FaultInjector
+    from llm_np_cp_tpu.serve.http.client import astream_completion
+    from llm_np_cp_tpu.serve.http.server import HttpServer
+
+    cfg, params = tiny
+    inj = FaultInjector("tick_crash@12")
+    tracer = TraceRecorder()
+    engine = _engine(cfg, params, max_slots=4, num_blocks=64,
+                     fault_injector=inj, tracer=tracer)
+    # compile outside the measured window (and outside the chaos
+    # schedule — warmup suspends both injector and tracer)
+    engine.warmup([12], max_new_tokens=5)
+    assert len(tracer) == 0, "warmup must not pollute the timeline"
+    rng = np.random.default_rng(11)
+    reqs = [
+        (rng.integers(1, cfg.vocab_size,
+                      size=int(rng.integers(3, 12))).tolist(),
+         int(rng.integers(3, 6)))
+        for _ in range(32)
+    ]
+
+    async def main():
+        srv = HttpServer(engine, model_id="tiny", drain_timeout=30.0,
+                         max_restarts=3, restart_backoff_s=0.05)
+        await srv.start("127.0.0.1", 0)
+
+        async def one(i, p, m):
+            await asyncio.sleep(0.02 * i)  # staggered Poisson-ish ramp
+            return await astream_completion(
+                srv.host, srv.port,
+                {"prompt": p, "max_tokens": m, "stream": True},
+                timeout=120, retries=4, backoff_s=0.05,
+            )
+
+        results = await asyncio.gather(
+            *(one(i, p, m) for i, (p, m) in enumerate(reqs)))
+        srv.begin_drain()
+        await asyncio.wait_for(srv.serve_until_shutdown(), timeout=60)
+        return srv, results
+
+    srv, results = asyncio.run(asyncio.wait_for(main(), timeout=300))
+    assert all(r["status"] == 200 and r["finish_reason"] == "length"
+               for r in results), results
+    assert srv.runner.restarts >= 1
+    assert inj.injected["tick_crash"] == 1
+
+    events = tracer.events()
+    snap = srv.runner.engine.metrics.snapshot()
+    finishes = [ev for ev in events
+                if ev.get("cat") == "request" and ev["ph"] == "n"
+                and ev["name"] == "finish"]
+    assert len(finishes) == snap["finished"] == 32
+    finished_rids = {ev["id"] for ev in finishes}
+    http_rids = {ev["id"] for ev in events
+                 if ev.get("cat") == "request" and ev["name"] == "http"
+                 and ev["ph"] == "b"}
+    assert http_rids == finished_rids  # every accepted request resolved
+    recovers = [ev for ev in events
+                if ev.get("cat") == "request" and ev["ph"] == "n"
+                and ev["name"] == "recovery-replay"]
+    assert len(recovers) == snap["recovered"] >= 1
+    sup = [ev for ev in events if ev.get("cat") == "supervisor"]
+    assert any(ev["name"] == "engine-death" for ev in sup)
+    assert any(ev["name"] == "restart" and ev["ph"] == "X" for ev in sup)
+
+    # phase-coverage invariant holds across the crash + recovery
+    stats = tick_stats(events)
+    assert stats["ticks"] > 0
+    assert stats["phase_coverage"] >= 0.9
+
+
+def test_histograms_survive_sample_trimming(tiny):
+    """max_samples trims the percentile windows; the histogram counters
+    must stay exact anyway (they are maintained incrementally)."""
+    from llm_np_cp_tpu.serve.metrics import ServeMetrics
+    from llm_np_cp_tpu.serve.scheduler import Request
+
+    m = ServeMetrics(max_samples=10)
+    for i in range(100):
+        req = Request(req_id=i, prompt=np.asarray([1], np.int32),
+                      max_new_tokens=2)
+        req.submit_time = 0.0
+        req.first_token_time = 0.004 * (i + 1)
+        req.finish_time = req.first_token_time + 0.01
+        req.generated = [1, 2]
+        m.on_finish(req)
+    assert len(m.ttft_s) <= 10  # window trimmed...
+    prom = m.prometheus()
+    n = int(re.search(r"^llm_serve_ttft_seconds_count (\d+)$",
+                      prom, re.M).group(1))
+    assert n == 100  # ...histogram exact

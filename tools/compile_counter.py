@@ -2,7 +2,8 @@
 
 The serving engine's whole design rests on jit-stable steps: a tick must
 never retrace (a recompile mid-traffic is a multi-second stall for every
-queued request).  This module gives tests and CI two independent probes:
+queued request).  This module gives tests and CI three independent
+probes:
 
 - ``CompileCounter`` — a ``jax.monitoring`` listener counting backend
   compile events process-wide; wrap a block of ticks and assert zero new
@@ -12,15 +13,24 @@ queued request).  This module gives tests and CI two independent probes:
   the static-shape contract: decode/sample/prefill compile ONCE (the
   temp prefill cache is padded to a fixed capacity), scatter once per
   distinct prefill block count (phase shapes), never per tick.
+- ``assert_tracing_hooks_guarded()`` — the tracing-off discipline lint:
+  an AST pass over the serve hot-path modules asserting every
+  ``serve/tracing.py`` hook sits behind an ``is None`` check, so with
+  tracing off the per-tick cost is attribute loads + branches — no
+  Python allocations and no calls on the hot path (the FaultInjector
+  discipline, now pinned instead of promised).
 
-Run from tests (tests/test_serve_static_shapes.py); usable standalone:
+Run from tests (tests/test_serve_static_shapes.py,
+tests/test_serve_tracing.py); usable standalone:
 
     python tools/compile_counter.py   # self-check on a tiny synthetic trace
 """
 
 from __future__ import annotations
 
+import ast
 import contextlib
+import pathlib
 from typing import Iterator
 
 # Event keys that indicate an XLA computation was compiled.  jax renamed
@@ -118,6 +128,92 @@ def assert_serve_compiles_bounded(
     if problems:
         raise AssertionError(
             "serve/ static-shape lint failed:\n  " + "\n  ".join(problems)
+        )
+
+
+# serve hot-path modules whose tracing hooks the lint below pins
+_TRACED_HOT_PATHS = (
+    "llm_np_cp_tpu/serve/engine.py",
+    "llm_np_cp_tpu/serve/http/server.py",
+)
+
+
+def assert_tracing_hooks_guarded(files: tuple[str, ...] = _TRACED_HOT_PATHS,
+                                 ) -> None:
+    """The tracing-off zero-overhead lint.
+
+    For every function in the hot-path modules: any call through a
+    tracer binding — a local assigned from ``<x>.tracer`` or
+    ``getattr(<x>, "tracer", ...)``, or a direct ``<x>.tracer.<m>()``
+    attribute chain — must be accompanied by an ``is None`` /
+    ``is not None`` comparison on that binding in the same function.
+    This is what makes tracing-off a branch instead of work: no dict or
+    tuple is built for a recorder that is not there, and the decode/
+    prefill hot loop allocates nothing it did not allocate before
+    tracing existed.
+    """
+    root = pathlib.Path(__file__).resolve().parent.parent
+    problems: list[str] = []
+    for rel in files:
+        path = root / rel
+        tree = ast.parse(path.read_text())
+        for fn in (n for n in ast.walk(tree)
+                   if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))):
+            tracer_locals: set[str] = set()
+            attr_guarded = False
+            name_guarded: set[str] = set()
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Assign):
+                    v = node.value
+                    is_tracer = (
+                        isinstance(v, ast.Attribute) and v.attr == "tracer"
+                    ) or (
+                        isinstance(v, ast.Call)
+                        and isinstance(v.func, ast.Name)
+                        and v.func.id == "getattr"
+                        and len(v.args) >= 2
+                        and isinstance(v.args[1], ast.Constant)
+                        and v.args[1].value == "tracer"
+                    )
+                    if is_tracer:
+                        for t in node.targets:
+                            if isinstance(t, ast.Name):
+                                tracer_locals.add(t.id)
+                elif isinstance(node, ast.Compare) and any(
+                    isinstance(op, (ast.Is, ast.IsNot)) for op in node.ops
+                ) and any(
+                    isinstance(c, ast.Constant) and c.value is None
+                    for c in node.comparators
+                ):
+                    if isinstance(node.left, ast.Name):
+                        name_guarded.add(node.left.id)
+                    elif (isinstance(node.left, ast.Attribute)
+                          and node.left.attr == "tracer"):
+                        attr_guarded = True
+            for node in ast.walk(fn):
+                if not (isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Attribute)):
+                    continue
+                base = node.func.value
+                if isinstance(base, ast.Attribute) and base.attr == "tracer":
+                    if not attr_guarded:
+                        problems.append(
+                            f"{rel}:{node.lineno}: .tracer."
+                            f"{node.func.attr}() in {fn.name}() without an "
+                            "'is (not) None' guard on the tracer attribute"
+                        )
+                elif (isinstance(base, ast.Name)
+                      and base.id in tracer_locals
+                      and base.id not in name_guarded):
+                    problems.append(
+                        f"{rel}:{node.lineno}: tracer local {base.id!r} "
+                        f"called in {fn.name}() without an "
+                        "'is (not) None' guard"
+                    )
+    if problems:
+        raise AssertionError(
+            "tracing-off zero-overhead lint failed:\n  "
+            + "\n  ".join(problems)
         )
 
 
@@ -219,6 +315,25 @@ def _self_check() -> None:
     held = rebuilt.pool.stats()["request_held"]
     assert held == 0, f"recovery replay leaked {held} blocks"
     print(f"compile counts OK (restart+recovery): {rebuilt.compile_counts()}")
+
+    # tracing is host-side only: attaching a recorder mid-life and
+    # replaying more traffic must not compile anything new (the step
+    # jaxprs cannot see the tracer), and the hot-path hooks must all be
+    # is-None-guarded (the tracing-off zero-overhead lint)
+    assert_tracing_hooks_guarded()
+    from llm_np_cp_tpu.serve.tracing import TraceRecorder
+
+    warm = dict(rebuilt.compile_counts())
+    rebuilt.tracer = TraceRecorder(ring=10_000)
+    for p in prompts:
+        rebuilt.submit(p, 6)
+    rebuilt.run_until_complete()
+    assert rebuilt.compile_counts() == warm, (
+        f"tracing recompiled: {warm} -> {rebuilt.compile_counts()}"
+    )
+    assert len(rebuilt.tracer) > 0, "tracer attached but recorded nothing"
+    rebuilt.tracer = None
+    print(f"compile counts OK (traced): {rebuilt.compile_counts()}")
 
 
 if __name__ == "__main__":

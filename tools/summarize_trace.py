@@ -1,0 +1,183 @@
+"""Summarize a serve trace-event JSON dump without leaving the terminal.
+
+The Perfetto UI is the right tool for staring at one slow tick; this is
+the right tool for the first question — *where did the time go overall?*
+Reads the Chrome trace-event JSON written by ``--trace-out`` (or scraped
+from ``GET /debug/trace``) and prints:
+
+- **per-phase totals** — count / total / mean / max for every tick-phase
+  slice (admission, prefill, grow, decode_dispatch, host_sync, deliver)
+  and the prefill_chunk dispatches, plus the phase-coverage ratio
+  (phase time / tick time — the tracer's own sanity invariant);
+- **top-K slowest ticks** — timestamp, duration, and the tick's args
+  (active slots, queue depth, admissions), the starting point for any
+  p99 hunt;
+- **per-request lifecycle table** — queued / prefill / decode (and, when
+  the HTTP layer traced it, the accept→response bracket) per request,
+  with eviction/recovery counts and the finish reason.
+
+Usage::
+
+    python tools/summarize_trace.py TRACE.json [--top K]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+from collections import defaultdict
+from typing import Any
+
+# The request-lifecycle table columns: serve.tracing.REQUEST_PHASES plus
+# the HTTP layer's accept→response bracket span.  Kept as a local copy
+# so this tool stays stdlib-only (no jax import just to print a table);
+# pinned equal to the recorder's vocabulary by tests/test_serve_tracing.
+LIFECYCLE_COLUMNS = ("queued", "prefill", "decode", "http")
+
+
+def load_trace(path: str) -> list[dict]:
+    """Accepts the ``{"traceEvents": [...]}`` wrapper or a bare event
+    list (both are valid Chrome trace JSON)."""
+    with open(path) as f:
+        data = json.load(f)
+    events = data.get("traceEvents") if isinstance(data, dict) else data
+    if not isinstance(events, list):
+        raise ValueError(f"{path}: not a trace-event JSON file")
+    return events
+
+
+def phase_totals(events: list[dict]) -> dict[str, dict[str, float]]:
+    """name → {count, total_us, mean_us, max_us} over the synchronous
+    slices (tick phases + prefill chunks)."""
+    out: dict[str, dict[str, float]] = {}
+    for ev in events:
+        if ev.get("ph") != "X" or ev.get("cat") not in ("phase", "prefill"):
+            continue
+        rec = out.setdefault(ev["name"],
+                             {"count": 0, "total_us": 0.0, "max_us": 0.0})
+        rec["count"] += 1
+        rec["total_us"] += ev.get("dur", 0.0)
+        rec["max_us"] = max(rec["max_us"], ev.get("dur", 0.0))
+    for rec in out.values():
+        rec["mean_us"] = rec["total_us"] / rec["count"] if rec["count"] else 0.0
+    return out
+
+
+def tick_stats(events: list[dict]) -> dict[str, float]:
+    """Tick count/total plus phase coverage (sum of phase durations over
+    sum of tick durations — the contiguous-timestamps invariant)."""
+    tick_us = sum(e.get("dur", 0.0) for e in events
+                  if e.get("ph") == "X" and e.get("cat") == "tick")
+    phase_us = sum(e.get("dur", 0.0) for e in events
+                   if e.get("ph") == "X" and e.get("cat") == "phase")
+    n = sum(1 for e in events
+            if e.get("ph") == "X" and e.get("cat") == "tick")
+    return {
+        "ticks": n,
+        "tick_total_us": tick_us,
+        "phase_total_us": phase_us,
+        "phase_coverage": phase_us / tick_us if tick_us else 0.0,
+    }
+
+
+def slowest_ticks(events: list[dict], k: int) -> list[dict]:
+    ticks = [e for e in events
+             if e.get("ph") == "X" and e.get("cat") == "tick"]
+    return sorted(ticks, key=lambda e: e.get("dur", 0.0), reverse=True)[:k]
+
+
+def request_table(events: list[dict]) -> dict[Any, dict]:
+    """rid → per-phase durations (µs, summed across requeues), eviction/
+    recovery counts, and the finish reason, from the async request
+    events."""
+    table: dict[Any, dict] = defaultdict(lambda: {
+        "phases_us": defaultdict(float), "evictions": 0, "recoveries": 0,
+        "finish": None,
+    })
+    open_spans: dict[tuple, float] = {}
+    for ev in events:
+        if ev.get("cat") != "request":
+            continue
+        rid, name, ph = ev.get("id"), ev["name"], ev["ph"]
+        if ph == "b":
+            open_spans[(rid, name)] = ev["ts"]
+        elif ph == "e":
+            t0 = open_spans.pop((rid, name), None)
+            if t0 is not None:
+                table[rid]["phases_us"][name] += ev["ts"] - t0
+        elif ph == "n":
+            if name == "finish":
+                table[rid]["finish"] = (ev.get("args") or {}).get("reason")
+            elif name == "evicted-requeued":
+                table[rid]["evictions"] += 1
+            elif name == "recovery-replay":
+                table[rid]["recoveries"] += 1
+    return dict(table)
+
+
+def format_summary(events: list[dict], top: int = 5) -> str:
+    lines: list[str] = []
+    totals = phase_totals(events)
+    stats = tick_stats(events)
+    lines.append("== tick phases ==")
+    lines.append(f"{'phase':<16} {'count':>7} {'total_ms':>10} "
+                 f"{'mean_us':>9} {'max_us':>9}")
+    for name, rec in sorted(totals.items(),
+                            key=lambda kv: -kv[1]["total_us"]):
+        lines.append(
+            f"{name:<16} {rec['count']:>7} {rec['total_us'] / 1e3:>10.2f} "
+            f"{rec['mean_us']:>9.1f} {rec['max_us']:>9.1f}"
+        )
+    lines.append(
+        f"{stats['ticks']} ticks, {stats['tick_total_us'] / 1e3:.2f} ms "
+        f"total, phase coverage {stats['phase_coverage']:.1%}"
+    )
+    lines.append(f"== top {top} slowest ticks ==")
+    for ev in slowest_ticks(events, top):
+        args = ev.get("args") or {}
+        lines.append(
+            f"  ts={ev['ts'] / 1e3:.2f}ms dur={ev.get('dur', 0.0):.0f}us "
+            f"active={args.get('active_slots', '-')} "
+            f"queue={args.get('queue_depth', '-')} "
+            f"admitted={args.get('admitted', '-')}"
+        )
+    table = request_table(events)
+    lines.append("== requests ==")
+    lines.append(
+        f"{'rid':>5} "
+        + " ".join(f"{c + '_ms':>10}" for c in LIFECYCLE_COLUMNS)
+        + f" {'evict':>5} {'recov':>5} finish"
+    )
+    for rid in sorted(table, key=str):
+        rec = table[rid]
+        p = rec["phases_us"]
+
+        def ms(name: str) -> str:
+            return f"{p[name] / 1e3:.2f}" if name in p else "-"
+
+        lines.append(
+            f"{rid!s:>5} "
+            + " ".join(f"{ms(c):>10}" for c in LIFECYCLE_COLUMNS)
+            + f" {rec['evictions']:>5} {rec['recoveries']:>5} "
+            f"{rec['finish'] or '-'}"
+        )
+    return "\n".join(lines)
+
+
+def main(argv: list[str] | None = None) -> str:
+    p = argparse.ArgumentParser(
+        description="Per-phase totals, slowest ticks, and per-request "
+        "lifecycle tables from a serve --trace-out dump",
+    )
+    p.add_argument("trace", help="trace-event JSON file "
+                   "(--trace-out / GET /debug/trace)")
+    p.add_argument("--top", type=int, default=5,
+                   help="how many slowest ticks to list")
+    args = p.parse_args(argv)
+    out = format_summary(load_trace(args.trace), top=args.top)
+    print(out)
+    return out
+
+
+if __name__ == "__main__":
+    main()
