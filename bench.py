@@ -205,6 +205,22 @@ SERVE_CHAOS_CONFIGS = {
                               tick_deadline=30.0, backoff=0.05),
 }
 
+# Unified-tick leg (ServeEngine mixed_step): the SAME long-prefill-heavy
+# Poisson trace (mixed chat+completion decode budgets, prompts skewed
+# long so admissions land mid-decode) replayed twice on one engine
+# geometry — phase-split tick vs unified mixed tick — so the ragged
+# kernel's headline claim is a measured delta on identical arrivals:
+# lower p99 TTFT at equal-or-better decode tok/s, with strictly fewer
+# device dispatches per tick.
+SERVE_MIXED_CONFIGS = {
+    "serve_mixed_poisson": dict(model="llama1b", requests=32, rate=16.0,
+                                prompt_len=512, max_tokens=64, slots=8,
+                                block_size=128),
+    "smoke_serve_mixed": dict(model="tiny", requests=8, rate=50.0,
+                              prompt_len=28, max_tokens=8, slots=2,
+                              block_size=8),
+}
+
 SPEC_CONFIGS = {
     # batched self-speculation: bf16 target + int8 self-draft, γ=4
     "int8_spec_bs8": dict(model="llama1b", batch=8, prompt_len=128,
@@ -240,6 +256,7 @@ PRIORITY = [
     "ragged_bs8_fdec",
     "serve_poisson_bs8",  # continuous-batching serving engine (serve/)
     "serve_prefix_shared",  # prefix-cache reuse + gather-vs-paged decode
+    "serve_mixed_poisson",  # unified ragged tick vs phase-split head-to-head
     "serve_http_poisson",  # HTTP front-end overhead vs direct engine calls
     "serve_chaos_poisson",  # supervised recovery under a seeded fault schedule
     "gemma2_2b_bs8",      # Gemma north-star number (VERDICT task 3)
@@ -272,6 +289,7 @@ assert set(PRIORITY) == {
     for n in list(DECODE_CONFIGS) + list(SPEC_CONFIGS)
     + list(PREFILL_CONFIGS) + list(RAGGED_CONFIGS) + list(SERVE_CONFIGS)
     + list(SERVE_HTTP_CONFIGS) + list(SERVE_CHAOS_CONFIGS)
+    + list(SERVE_MIXED_CONFIGS)
     if not n.startswith("smoke")
 } | EXTRA_CHILDREN, "PRIORITY out of sync with config dicts"
 
@@ -292,6 +310,10 @@ TIMEOUTS = {
     # arrival pacing (~2s traffic span each) on top of the serve compile
     # budget; the HTTP leg adds event-loop + SSE framing time per token
     "serve_http_poisson": 850,
+    # two trace replays (split + unified) on one param build, each with
+    # its own warmup — the unified leg warms one mixed_step compile per
+    # packed-width bucket
+    "serve_mixed_poisson": 850,
     # clean + chaos HTTP legs at realtime pacing, plus a supervised
     # restart (backoff + pool rebuild + teacher-forced replay prefills)
     # inside the chaos leg's measured span
@@ -826,6 +848,127 @@ def run_serve_config(name: str) -> dict:
     }
 
 
+def run_serve_mixed_config(name: str) -> dict:
+    """Unified ragged tick vs phase-split: ONE long-prefill-heavy
+    Poisson trace (prompts skewed toward the long end, mixed
+    chat+completion decode budgets) replayed through two engines of
+    identical geometry — ``mixed_step="off"`` (admission → prefill
+    chunks → grow → decode, one dispatch per phase) and
+    ``mixed_step="on"`` (one ragged mixed dispatch per tick with the
+    SLO token-budget planner).  The observables are the ISSUE's
+    acceptance targets: p99 TTFT (long prefills no longer stall
+    decoders), decode tok/s (equal or better), token parity between
+    legs, and device dispatches per tick (strictly fewer unified)."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from llm_np_cp_tpu.ops.sampling import Sampler
+    from llm_np_cp_tpu.serve import ServeEngine, poisson_trace
+
+    t0 = time.perf_counter()
+    spec = SERVE_MIXED_CONFIGS[name]
+    config, params = _build_model(spec["model"], tag=name, t0=t0)
+    _phase(name, "params_built", t0)
+    from llm_np_cp_tpu.ops.pallas.support import (
+        kernel_error,
+        ragged_kernel_name,
+    )
+    from llm_np_cp_tpu.serve.engine import pool_geometry
+
+    bs = spec["block_size"]
+    chunk = min(bs * 2, 256)
+    _, num_blocks, max_seq_len = pool_geometry(
+        spec["prompt_len"], spec["max_tokens"], spec["slots"], bs,
+        prefill_chunk=chunk,
+    )
+    ragged_err = kernel_error(ragged_kernel_name(False))
+
+    # long-prefill-heavy: prompts in the TOP half of the length range,
+    # decode budgets mixed chat (short) + completion (long) — the shape
+    # where a monolithic prefill visibly stalls the decode batch
+    rng = np.random.default_rng(17)
+    trace = poisson_trace(
+        rng, spec["requests"], rate_rps=spec["rate"],
+        prompt_len_range=(max(spec["prompt_len"] // 2, 2),
+                          spec["prompt_len"]),
+        max_new_tokens=(max(spec["max_tokens"] // 8, 1),
+                        spec["max_tokens"]),
+        vocab_size=config.vocab_size, seed_base=17,
+    )
+    _phase(name, "trace_built", t0)
+
+    per_leg: dict = {}
+    tokens_by_leg: dict = {}
+    for leg, mode in (("split", "off"), ("mixed", "on")):
+        engine = ServeEngine(
+            params, config,
+            sampler=Sampler(kind="greedy"),
+            max_slots=spec["slots"],
+            num_blocks=num_blocks,
+            block_size=bs,
+            max_seq_len=max_seq_len,
+            prefill_chunk=chunk,
+            cache_dtype=jnp.bfloat16,
+            mixed_step=mode,
+        )
+        engine.warmup([int(t["prompt"].size) for t in trace],
+                      max_new_tokens=spec["max_tokens"])
+        engine.n_dispatches = 0  # count the measured span only
+        _phase(name, f"warmed_{leg}", t0)
+        snap = engine.replay_trace(trace)
+        _phase(name, f"trace_drained_{leg}", t0, ticks=snap["ticks"])
+        tokens_by_leg[leg] = {
+            r.req_id: list(r.generated)
+            for r in engine.scheduler.finished
+        }
+        per_leg[leg] = {
+            "ok": snap["finished"] == spec["requests"],
+            "throughput_tok_s": round(snap["throughput_tok_s"], 1),
+            "ttft_s_p50": round(snap.get("ttft_s_p50", float("nan")), 4),
+            "ttft_s_p99": round(snap.get("ttft_s_p99", float("nan")), 4),
+            "decode_tok_s_p50": round(snap.get("decode_tok_s_p50",
+                                               float("nan")), 1),
+            "ticks": snap["ticks"],
+            "dispatches": engine.n_dispatches,
+            "dispatches_per_tick": round(
+                engine.n_dispatches / max(snap["ticks"], 1), 3
+            ),
+            "preemptions": snap["preemptions"],
+            "mixed_prefill_tokens": snap["mixed_prefill_tokens"],
+            "mixed_decode_tokens": snap["mixed_decode_tokens"],
+            "compile_counts": engine.compile_counts(),
+        }
+        if mode == "on":
+            per_leg[leg]["ragged_attn_impl"] = engine.ragged_attn_impl
+            per_leg[leg]["tick_token_budget"] = engine.tick_token_budget
+            per_leg[leg]["buckets"] = list(engine.mixed_buckets)
+        del engine
+
+    parity = tokens_by_leg["split"] == tokens_by_leg["mixed"]
+    m, s = per_leg["mixed"], per_leg["split"]
+    return {
+        "config": name,
+        "ok": all(r["ok"] for r in per_leg.values()) and parity,
+        "requests": spec["requests"],
+        "rate_rps": spec["rate"],
+        "slots": spec["slots"],
+        "pool_blocks": num_blocks,
+        "block_size": bs,
+        "token_parity_mixed_vs_split": parity,
+        # headline: the unified tick's deltas on identical arrivals
+        "ttft_s_p99": m["ttft_s_p99"],
+        "ttft_s_p99_split": s["ttft_s_p99"],
+        "decode_tok_s_p50": m["decode_tok_s_p50"],
+        "decode_tok_s_p50_split": s["decode_tok_s_p50"],
+        "throughput_tok_s": m["throughput_tok_s"],
+        "dispatches_per_tick": m["dispatches_per_tick"],
+        "dispatches_per_tick_split": s["dispatches_per_tick"],
+        "dispatch_win": m["dispatches"] < s["dispatches"],
+        "legs": per_leg,
+        "ragged_kernel_probe": ragged_err or "ok",
+    }
+
+
 def _client_pct(vals: list, q: float) -> float:
     """Client-observed-TTFT percentile — the SAME estimator as
     ServeMetrics._pcts (np.percentile linear interpolation), shared by
@@ -1262,6 +1405,7 @@ def run_warm() -> dict:
         if n not in SPEC_CONFIGS and n not in EXTRA_CHILDREN
         and n not in RAGGED_CONFIGS and n not in SERVE_CONFIGS
         and n not in SERVE_HTTP_CONFIGS and n not in SERVE_CHAOS_CONFIGS
+        and n not in SERVE_MIXED_CONFIGS
     ]
     for name in warmable[:warm_limit]:
         spec = {**DECODE_CONFIGS, **PREFILL_CONFIGS}[name]
@@ -1600,6 +1744,8 @@ def child_main(mode: str) -> None:
         out = run_ragged_config(mode)
     elif mode in SERVE_CONFIGS:
         out = run_serve_config(mode)
+    elif mode in SERVE_MIXED_CONFIGS:
+        out = run_serve_mixed_config(mode)
     elif mode in SERVE_HTTP_CONFIGS:
         out = run_serve_http_config(mode)
     elif mode in SERVE_CHAOS_CONFIGS:
@@ -1863,7 +2009,8 @@ def main() -> None:
         budget = min(TIMEOUTS.get(name, DEFAULT_TIMEOUT), remaining - 10)
         spec_env = {
             **DECODE_CONFIGS, **PREFILL_CONFIGS, **SPEC_CONFIGS,
-            **RAGGED_CONFIGS, **SERVE_CONFIGS, **SERVE_HTTP_CONFIGS,
+            **RAGGED_CONFIGS, **SERVE_CONFIGS, **SERVE_MIXED_CONFIGS,
+            **SERVE_HTTP_CONFIGS,
             **SERVE_CHAOS_CONFIGS,
         }.get(name, {}).get("env")
         res = _spawn(name, budget, env=spec_env)

@@ -151,6 +151,28 @@ def _add_serve_engine_flags(p: argparse.ArgumentParser,
                    help="attention kernel for the GATHERED decode step "
                    "(pallas is gated: it silently downgrades off-TPU); "
                    "ignored under --attn-impl paged")
+    p.add_argument("--mixed-step", choices=["auto", "on", "off"],
+                   default="auto",
+                   help="unified ragged prefill+decode tick: ONE device "
+                   "dispatch per tick runs a mixed batch of prefill "
+                   "chunk slices and decode rows against the paged pool "
+                   "(ragged_paged_attention), with prefill K/V written "
+                   "straight into pool blocks and decode co-scheduled "
+                   "under --tick-token-budget.  'auto' (default) takes "
+                   "the unified tick when the ragged kernel's Mosaic "
+                   "probe passes and falls back to the phase-split tick "
+                   "otherwise; 'on' forces it (XLA ragged fallback if "
+                   "the kernel is rejected); 'off' is the phase-split "
+                   "engine (--attn-impl/--decode-attn then select its "
+                   "decode path)")
+    p.add_argument("--tick-token-budget", type=int, default=0, metavar="N",
+                   help="unified tick only: token budget per tick — "
+                   "decode rows are budgeted first (never starved), "
+                   "remaining tokens go to prefill chunk slices, so a "
+                   "long prefill spreads over ticks instead of stalling "
+                   "the decode batch.  Must be >= --slots; larger = "
+                   "faster TTFT, smaller = steadier decode cadence.  "
+                   "0 = slots + 2*prefill_chunk")
     p.add_argument("--sampler", choices=["greedy", "min_p", "top_k", "top_p",
                                          "cdf"], default="greedy")
     p.add_argument("--dtype", choices=["bf16", "f32"], default="bf16")
@@ -276,6 +298,13 @@ def _validate_pool_flags(args) -> None:
         raise SystemExit(
             f"--trace-ring must be >= 0, got {args.trace_ring}"
         )
+    budget = getattr(args, "tick_token_budget", 0)
+    if budget < 0 or (budget and budget < args.slots):
+        raise SystemExit(
+            f"--tick-token-budget must be 0 (auto) or >= --slots "
+            f"({args.slots}) so decode rows are never starved, got "
+            f"{budget}"
+        )
 
 
 def _chaos_injector(args):
@@ -391,7 +420,16 @@ def _build_serve_engine(args, params, config, *, prog: str,
         tokenizer=tokenizer,
         fault_injector=fault_injector,
         tracer=tracer,
+        mixed_step=getattr(args, "mixed_step", "off"),
+        tick_token_budget=getattr(args, "tick_token_budget", 0) or None,
     )
+    if engine.mixed:
+        print(f"[{prog}] unified tick ACTIVE: one mixed dispatch/tick, "
+              f"budget {engine.tick_token_budget} tokens "
+              f"(ragged attention: {engine.ragged_attn_impl})")
+    elif getattr(args, "mixed_step", "off") == "auto":
+        print(f"[{prog}] --mixed-step auto: ragged kernel unavailable; "
+              "using the phase-split tick")
     return engine, num_blocks
 
 
@@ -450,10 +488,16 @@ def _run_serve_bench(argv: list[str], default_model: str) -> str:
     with _jax_profile_ctx(args):
         snap = engine.replay_trace(trace, realtime=args.realtime)
     _dump_trace(engine.tracer, args, "serve-bench")
+    tick = (
+        f"mixed:{engine.ragged_attn_impl}"
+        f"(budget={engine.tick_token_budget})"
+        if engine.mixed else "split"
+    )
     out = (
         f"[serve-bench] {args.requests} requests @ {args.rate} req/s, "
         f"slots={args.slots}, pool={num_blocks}x{args.block_size} "
         f"({args.cache_dtype}), attn={engine.decode_attn_impl}, "
+        f"tick={tick}, "
         f"prefix_cache={'on' if args.prefix_cache else 'off'}\n"
         + engine.metrics.format()
     )

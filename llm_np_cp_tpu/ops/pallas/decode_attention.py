@@ -144,28 +144,48 @@ def _decode_kernel(
         o_ref[0] = (acc_ref[:] / l).reshape(o_ref.shape[1:]).astype(o_ref.dtype)
 
 
+# Sublane alignment for a block_s that is NOT the full cache length.
+# block_s is the SECOND-MINOR dim of the bool mask block (1, block_s, 1)
+# — Mosaic's sublane tiling for 1-byte element types is (32, 128), so an
+# 8-aligned-but-not-32-aligned partial block compiles for the f32/bf16
+# K/V specs and then dies on the mask spec.  That is the BENCH_TPU_LIVE_r4
+# "block shape divisibility" warm-log failure class (fdec): interpret
+# mode hides it, only a hardware compile rejects it.  32 also covers the
+# int8 K/V pages, whose own second-minor is block_s-free (full trailing
+# dims) but whose scale pages ride the same block length.
+_BLOCK_S_ALIGN = 32
+
+
 def select_block_s(
     s: int, kv_heads: int, head_dim: int, kv_itemsize: int,
     requested: int, quantized: bool,
 ) -> int:
-    """Largest kv-block length that divides ``s``, is 8-aligned (Mosaic
-    second-minor rule for the [B, S, 1] mask block), and keeps the
-    double-buffered K/V(+scale) working set inside the VMEM budget.
+    """Largest kv-block length that divides ``s``, is 32-aligned (the
+    strictest sublane tile among the streamed operands — see
+    ``_BLOCK_S_ALIGN``), and keeps the double-buffered K/V(+scale)
+    working set inside the VMEM budget.
 
-    Falls back to a single whole-``s`` block for short unaligned caches
-    (then the block equals the full dim, which Mosaic also accepts).
-    Raises for caches that are both unaligned and too large — Generator
-    sizes caches to multiples of 128 (generate.py) so real callers never
-    hit that.
+    Falls back to a single whole-``s`` block for short caches with no
+    aligned divisor (then every block dim equals the full array dim,
+    which Mosaic always accepts).  Raises for caches that have no
+    aligned divisor and are too large for one VMEM block —
+    ``decode_attention`` catches that and PADS the cache instead of
+    dying (the r4 fdec debt: validate/pad, never hand Mosaic an
+    unaligned partial block).
     """
+    a = _BLOCK_S_ALIGN
+    # hints below the alignment (8/16/24 were valid pre-32) would make
+    # the candidate range empty and mis-raise on perfectly divisible
+    # caches; the alignment is the real floor, so clamp up to it
+    requested = max(requested, a)
     row_bytes = kv_heads * head_dim * kv_itemsize * 2  # K and V
     if quantized:
         row_bytes += kv_heads * 4 * 2  # f32 k/v scales
-    cap = max(8, (_VMEM_BUDGET_BYTES // (2 * row_bytes)) // 8 * 8)
+    cap = max(a, (_VMEM_BUDGET_BYTES // (2 * row_bytes)) // a * a)
     best = 0
-    # start aligned DOWN to 8 — an unaligned start would step through
+    # start aligned DOWN — an unaligned start would step through
     # exclusively unaligned candidates and miss every valid divisor
-    for cand in range(min(requested, cap, s) // 8 * 8, 7, -8):
+    for cand in range(min(requested, cap, s) // a * a, a - 1, -a):
         if s % cand == 0:
             best = cand
             break
@@ -175,9 +195,9 @@ def select_block_s(
     if 2 * s * row_bytes <= _VMEM_BUDGET_BYTES:
         return s  # single block; block dim == full dim satisfies Mosaic
     raise ValueError(
-        f"decode_attention: cache length {s} has no 8-aligned divisor and "
-        f"is too large for a single VMEM block; size caches to a multiple "
-        f"of 8 (Generator rounds capacities to 128)"
+        f"decode_attention: cache length {s} has no {a}-aligned divisor "
+        f"and is too large for a single VMEM block; pad the cache to a "
+        f"multiple of {a} (decode_attention does this automatically)"
     )
 
 
@@ -400,6 +420,347 @@ def paged_decode_attention(
     return out.reshape(b, 1, h, d)
 
 
+# ----------------------------------------------------------------------
+# Ragged mixed prefill+decode attention (the unified-tick kernel)
+# ----------------------------------------------------------------------
+
+# Query-tile width for the ragged kernel's packed token axis.  Every
+# row's token segment is padded up to a multiple of this so each q tile
+# belongs to exactly ONE row (the scalar-prefetched tile metadata then
+# names that row's block table).  8 = the f32 sublane tile; a decode row
+# costs one tile (7 masked query lanes) — acceptable, because the win of
+# the unified tick is ONE dispatch streaming the weights once for
+# prefill AND decode, not per-lane occupancy.
+RAGGED_Q_TILE = 8
+
+# meta rows for _ragged_kernel (computed in-graph per layer — the
+# sliding-window bound is a traced per-layer value)
+_RM_START, _RM_NB, _RM_PAD, _RM_QPOS0, _RM_QLEN, _RM_ROW, _RM_WIN = range(7)
+
+
+def _ragged_kernel(
+    meta_ref, tables_ref, *refs,
+    scale: float, softcap: float | None, quantized: bool, kv_heads: int,
+    group: int, block_s: int, q_tile: int, head_dim: int,
+):
+    """Mixed-batch block-table attention: each q tile holds up to
+    ``q_tile`` consecutive tokens of ONE row (a prefill-chunk slice, or a
+    decode row's single token with the tail masked), and the kv grid
+    step fetches the pool block named by the row's scalar-prefetched
+    table — the generalization of ``_paged_kernel`` from one query row
+    to a query tile.  Visibility is derived in-kernel from the tile's
+    (pad, qpos0, qlen, window) scalars: token i at cache slot
+    ``qpos0 + i`` sees kv slots in
+    ``[max(pad, slot - win + 1), slot]`` — causal within the tile's own
+    freshly-written K/V too, because the caller scatters the whole
+    packed batch into the pool before attending (same discipline as the
+    paged decode step)."""
+    if quantized:
+        (q_ref, k_ref, v_ref, ks_ref, vs_ref,
+         o_ref, m_ref, l_ref, acc_ref) = refs
+    else:
+        q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref = refs
+    ti = pl.program_id(0)
+    j = pl.program_id(1)
+    nj = pl.num_programs(1)
+    start, nb = meta_ref[_RM_START, ti], meta_ref[_RM_NB, ti]
+    pad, qpos0 = meta_ref[_RM_PAD, ti], meta_ref[_RM_QPOS0, ti]
+    qlen, win = meta_ref[_RM_QLEN, ti], meta_ref[_RM_WIN, ti]
+
+    @pl.when(j == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    @pl.when(start + j < nb)
+    def _update():
+        # rank-2 iota (Mosaic rejects rank-1 iota on TPU)
+        q_idx = jax.lax.broadcasted_iota(jnp.int32, (q_tile, block_s), 0)
+        kv_pos = (start + j) * block_s + jax.lax.broadcasted_iota(
+            jnp.int32, (q_tile, block_s), 1
+        )
+        q_slot = qpos0 + q_idx
+        mask = (
+            (q_idx < qlen)
+            & (kv_pos >= pad)
+            & (kv_pos > q_slot - win)  # sliding window (win huge = global)
+            & (kv_pos <= q_slot)       # causal
+        )  # [q_tile, block_s]
+        kb = k_ref[0]  # [block_s, K, D]
+        vb = v_ref[0]
+        dtype = q_ref.dtype
+        if quantized:
+            kb = kb.astype(dtype) * ks_ref[0][..., None].astype(dtype)
+            vb = vb.astype(dtype) * vs_ref[0][..., None].astype(dtype)
+        # per-kv-head MXU dots over the whole tile, concatenated to ONE
+        # [K*q_tile*G, block_s] score sheet (rows ordered (ki, qi, gi))
+        # so the mask/softcap/exp/rescale VPU pipeline runs once per
+        # block at full width — the _decode_kernel r5 lesson applied
+        s = jnp.concatenate(
+            [
+                jax.lax.dot_general(
+                    q_ref[:, ki].reshape(q_tile * group, head_dim),
+                    kb[:, ki], (((1,), (1,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                )
+                for ki in range(kv_heads)
+            ],
+            axis=0,
+        ) * scale  # [K*q_tile*G, block_s]
+        if softcap is not None:
+            s = jnp.tanh(s / softcap) * softcap
+        # mask rows order (qi, gi), identical for every kv head
+        mask_qg = jnp.broadcast_to(
+            mask[:, None, :], (q_tile, group, block_s)
+        ).reshape(q_tile * group, block_s)
+        mask_full = jnp.concatenate([mask_qg] * kv_heads, axis=0)
+        s = jnp.where(mask_full, s, NEG_INF)
+
+        m_prev = m_ref[:]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        # re-zero masked slots: a FULLY-masked query row (dead packing
+        # lane) has m == NEG_INF and would otherwise get p == 1
+        p = jnp.where(mask_full, p, 0.0)
+        alpha = jnp.exp(m_prev - m_new)
+        l_ref[:] = l_ref[:] * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        pb = p.astype(vb.dtype)
+        pv = jnp.concatenate(
+            [
+                jax.lax.dot_general(
+                    pb[ki * q_tile * group:(ki + 1) * q_tile * group],
+                    vb[:, ki], (((1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                )
+                for ki in range(kv_heads)
+            ],
+            axis=0,
+        )  # [K*q_tile*G, D]
+        acc_ref[:] = acc_ref[:] * alpha + pv
+        m_ref[:] = m_new
+
+    @pl.when(j == nj - 1)
+    def _finalize():
+        l = jnp.where(l_ref[:] == 0.0, 1.0, l_ref[:])
+        acc = acc_ref[:] / l
+        for ki in range(kv_heads):
+            o_ref[:, ki] = (
+                acc[ki * q_tile * group:(ki + 1) * q_tile * group]
+                .reshape(q_tile, group, head_dim)
+                .astype(o_ref.dtype)
+            )
+
+
+@functools.partial(
+    jax.jit, static_argnames=("scale", "logit_softcap", "interpret")
+)
+def ragged_paged_attention(
+    q: jnp.ndarray,
+    k_pages: jnp.ndarray,
+    v_pages: jnp.ndarray,
+    tables: jnp.ndarray,
+    tile_row: jnp.ndarray,
+    tile_qpos0: jnp.ndarray,
+    tile_qlen: jnp.ndarray,
+    pads: jnp.ndarray,
+    window: jnp.ndarray,
+    *,
+    k_scale: jnp.ndarray | None = None,
+    v_scale: jnp.ndarray | None = None,
+    scale: float,
+    logit_softcap: float | None = None,
+    interpret: bool | None = None,
+) -> jnp.ndarray:
+    """Mixed prefill+decode GQA attention straight off a paged KV pool.
+
+    One invocation handles a PACKED batch of rows with heterogeneous
+    query lengths — prefill-chunk slices and single-token decode rows —
+    against the same pool slabs (Ragged Paged Attention, the
+    unified-tick kernel).
+
+    q [T, H, D] — the packed token axis: each row's segment occupies
+    consecutive, ``RAGGED_Q_TILE``-aligned positions (the serve engine's
+    packer guarantees this; dead lanes between segments are masked via
+    ``tile_qlen``).  k_pages/v_pages [NB, BS, K, D] — ONE layer's pool
+    slab.  tables [R, MB] int32 block ids per engine row.  Per TILE
+    (T / RAGGED_Q_TILE entries): ``tile_row`` — the owning engine row,
+    ``tile_qpos0`` — the cache slot of the tile's first token,
+    ``tile_qlen`` — live tokens in the tile (0 = dead padding tile).
+    pads [R] — left-pad slots per row.  window — traced int32 scalar:
+    sliding-window width for this layer (pass a huge value for global
+    layers; the per-layer flag stays traced, so one compile serves
+    both).  → [T, H, D].
+
+    Token i of a tile sees kv slots ``[max(pad, slot_i - window + 1),
+    slot_i]`` where ``slot_i = tile_qpos0 + i`` — exactly the visibility
+    the phase-split engine's chunked prefill mask + paged decode step
+    encode, so outputs are parity-testable against both.  Blocks outside
+    the tile's visible range are never DMA'd (clamped index map, same
+    skip as ``paged_decode_attention``).
+
+    int8 pool mode: k_scale/v_scale [NB, BS, K] f32 scale pages ride
+    along and the kernel dequantizes per block in VMEM.
+    """
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    quantized = k_scale is not None
+    if (
+        quantized != (k_pages.dtype == jnp.int8)
+        or quantized != (v_pages.dtype == jnp.int8)
+        or quantized != (v_scale is not None)
+    ):
+        raise ValueError(
+            "int8 k_pages AND v_pages require both k_scale and v_scale "
+            f"pages (and vice versa); got k={k_pages.dtype}, "
+            f"v={v_pages.dtype}, "
+            f"k_scale={'set' if k_scale is not None else None}, "
+            f"v_scale={'set' if v_scale is not None else None}"
+        )
+    t, h, d = q.shape
+    qt = RAGGED_Q_TILE
+    if t % qt:
+        raise ValueError(
+            f"packed token axis ({t}) must be a multiple of "
+            f"RAGGED_Q_TILE ({qt})"
+        )
+    nt = t // qt
+    if tile_row.shape != (nt,):
+        raise ValueError(
+            f"tile metadata must have T/RAGGED_Q_TILE = {nt} entries, "
+            f"got {tile_row.shape}"
+        )
+    nb_pool, block_s, kh, _ = k_pages.shape
+    g = h // kh
+    mb = tables.shape[1]
+
+    qf = q.reshape(t, kh, g, d)
+    # per-tile kv block bounds: the window lower bound is tightest at the
+    # tile's FIRST token; the causal upper bound is set by its LAST live
+    # token.  The in-kernel mask handles per-token exactness — these only
+    # decide which blocks are streamed at all.
+    row_pad = pads[tile_row]
+    lo = jnp.maximum(row_pad, tile_qpos0 - window + 1)
+    hi = tile_qpos0 + jnp.maximum(tile_qlen, 1) - 1
+    start = jnp.clip(lo // block_s, 0, jnp.maximum(mb - 1, 0))
+    nb = jnp.clip(hi // block_s + 1, 1, mb)
+    meta = jnp.stack([
+        start, nb, row_pad, tile_qpos0, tile_qlen, tile_row,
+        jnp.broadcast_to(window, tile_row.shape),
+    ]).astype(jnp.int32)  # [7, NT]
+
+    def _kv_map(ti, j, meta_ref, tables_ref):
+        row = meta_ref[_RM_ROW, ti]
+        jj = jnp.minimum(
+            meta_ref[_RM_START, ti] + j, meta_ref[_RM_NB, ti] - 1
+        )
+        return (tables_ref[row, jj], 0, 0, 0)
+
+    def _scale_map(ti, j, meta_ref, tables_ref):
+        row = meta_ref[_RM_ROW, ti]
+        jj = jnp.minimum(
+            meta_ref[_RM_START, ti] + j, meta_ref[_RM_NB, ti] - 1
+        )
+        return (tables_ref[row, jj], 0, 0)
+
+    kv_spec = pl.BlockSpec((1, block_s, kh, d), _kv_map,
+                           memory_space=pltpu.VMEM)
+    q_spec = pl.BlockSpec(
+        (qt, kh, g, d),
+        lambda ti, j, meta_ref, tables_ref: (ti, 0, 0, 0),
+        memory_space=pltpu.VMEM,
+    )
+    in_specs = [q_spec, kv_spec, kv_spec]
+    operands = [qf, k_pages, v_pages]
+    if quantized:
+        scale_spec = pl.BlockSpec((1, block_s, kh), _scale_map,
+                                  memory_space=pltpu.VMEM)
+        in_specs += [scale_spec, scale_spec]
+        operands += [k_scale, v_scale]
+    out = pl.pallas_call(
+        functools.partial(
+            _ragged_kernel, scale=scale, softcap=logit_softcap,
+            quantized=quantized, kv_heads=kh, group=g, block_s=block_s,
+            q_tile=qt, head_dim=d,
+        ),
+        out_shape=jax.ShapeDtypeStruct((t, kh, g, d), q.dtype),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(nt, mb),
+            in_specs=in_specs,
+            out_specs=pl.BlockSpec(
+                (qt, kh, g, d),
+                lambda ti, j, meta_ref, tables_ref: (ti, 0, 0, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            scratch_shapes=[
+                pltpu.VMEM((kh * qt * g, 1), jnp.float32),
+                pltpu.VMEM((kh * qt * g, 1), jnp.float32),
+                pltpu.VMEM((kh * qt * g, d), jnp.float32),
+            ],
+        ),
+        interpret=interpret,
+    )(meta, tables, *operands)
+
+    return out.reshape(t, h, d)
+
+
+def ragged_paged_attention_xla(
+    q: jnp.ndarray,
+    k_pages: jnp.ndarray,
+    v_pages: jnp.ndarray,
+    tables: jnp.ndarray,
+    tok_row: jnp.ndarray,
+    tok_slot: jnp.ndarray,
+    tok_live: jnp.ndarray,
+    pads: jnp.ndarray,
+    window: jnp.ndarray,
+    *,
+    k_scale: jnp.ndarray | None = None,
+    v_scale: jnp.ndarray | None = None,
+    scale: float,
+    logit_softcap: float | None = None,
+) -> jnp.ndarray:
+    """XLA reference/fallback for ``ragged_paged_attention`` (per-TOKEN
+    metadata instead of per-tile): gathers each engine row's blocks into
+    a contiguous view and runs the standard masked GQA attention with
+    every packed token as its own batch row — the mixed-step analogue of
+    the engine's gather decode path.  Materializes [T, S_max, K, D], so
+    it is the PROBE-FAILURE fallback and the parity oracle, not the fast
+    path."""
+    t, h, d = q.shape
+    _, block_s, kh, _ = k_pages.shape
+    mb = tables.shape[1]
+    s_max = mb * block_s
+
+    def gathered(pages, scales):
+        view = pages[tables].reshape(tables.shape[0], s_max, kh, d)
+        if scales is None:
+            return view
+        sv = scales[tables].reshape(tables.shape[0], s_max, kh)
+        from llm_np_cp_tpu.cache import dequantize_kv
+
+        return dequantize_kv(view, sv, q.dtype)
+
+    k_rows = gathered(k_pages, k_scale)
+    v_rows = gathered(v_pages, v_scale)
+    k_t = k_rows[tok_row]  # [T, S_max, K, D]
+    v_t = v_rows[tok_row]
+    kv_idx = jnp.arange(s_max, dtype=jnp.int32)[None, :]
+    lower = jnp.maximum(pads[tok_row], tok_slot - window + 1)[:, None]
+    mask = (
+        (kv_idx >= lower) & (kv_idx <= tok_slot[:, None])
+        & tok_live[:, None]
+    )  # [T, S_max]
+    from llm_np_cp_tpu.ops.attention import gqa_attention
+
+    out = gqa_attention(
+        q[:, None], k_t, v_t, mask[:, None, :],
+        scale=scale, logit_softcap=logit_softcap,
+    )
+    return out[:, 0]
+
+
 @functools.partial(
     jax.jit,
     static_argnames=("scale", "logit_softcap", "block_s", "interpret"),
@@ -457,11 +818,28 @@ def decode_attention(
     # dims alignment rule is satisfied for any K/D.  q's head split
     # [B,1,H,D]→[B,K,G,D] is a free reshape.
     qf = q.reshape(b, kh, g, d)  # [B, K, G, D]
-    mask3 = mask[:, :, None]  # [B, S, 1]: trailing dims (block_s, 1)
 
-    block_s = select_block_s(
-        s, kh, d, jnp.dtype(k.dtype).itemsize, block_s, quantized
-    )
+    try:
+        block_s = select_block_s(
+            s, kh, d, jnp.dtype(k.dtype).itemsize, block_s, quantized
+        )
+    except ValueError:
+        # no aligned divisor and too large for one block: PAD the cache
+        # axis to the alignment and mask the tail off (the r4 fdec fix —
+        # a few dead slots beat a Mosaic rejection at first dispatch)
+        s_pad = -(-s // _BLOCK_S_ALIGN) * _BLOCK_S_ALIGN
+        pad = [(0, 0), (0, s_pad - s), (0, 0), (0, 0)]
+        k = jnp.pad(k, pad)
+        v = jnp.pad(v, pad)
+        mask = jnp.pad(mask, [(0, 0), (0, s_pad - s)])  # False = invisible
+        if quantized:
+            k_scale = jnp.pad(k_scale, [(0, 0), (0, s_pad - s), (0, 0)])
+            v_scale = jnp.pad(v_scale, [(0, 0), (0, s_pad - s), (0, 0)])
+        s = s_pad
+        block_s = select_block_s(
+            s, kh, d, jnp.dtype(k.dtype).itemsize, block_s, quantized
+        )
+    mask3 = mask[:, :, None]  # [B, S, 1]: trailing dims (block_s, 1)
     n_blocks = s // block_s
     bounds = _block_bounds(mask, block_s, n_blocks)
 

@@ -121,6 +121,39 @@ def _probe(kernel: str, backend: str) -> str | None:
                 q, pages, pages, tables, lengths, pads, scale=0.125,
                 interpret=False, **kwargs,
             ))
+        elif kernel in ("ragged_paged_attention", "ragged_paged_attention_int8"):
+            from llm_np_cp_tpu.ops.pallas.decode_attention import (
+                RAGGED_Q_TILE,
+                ragged_paged_attention,
+            )
+
+            # a representative mixed tick: one 2-tile prefill segment
+            # (ragged tail), one decode tile, one dead padding tile —
+            # the tile-metadata scalar-prefetch + q-tile layout class
+            # only a hardware compile validates
+            nbp, bs, khd = 6, 32, 64
+            qt = RAGGED_Q_TILE
+            t = 4 * qt
+            q = jnp.asarray(rng.standard_normal((t, 8, khd)), jnp.bfloat16)
+            pages = jnp.asarray(
+                rng.standard_normal((nbp, bs, 2, khd)), jnp.bfloat16
+            )
+            tables = jnp.asarray([[2, 1, 4], [3, 5, 0]], jnp.int32)
+            tile_row = jnp.asarray([0, 0, 1, 0], jnp.int32)
+            tile_qpos0 = jnp.asarray([5, 13, 40, 0], jnp.int32)
+            tile_qlen = jnp.asarray([8, 4, 1, 0], jnp.int32)
+            pads = jnp.asarray([5, 33], jnp.int32)
+            kwargs = {}
+            if kernel.endswith("int8"):
+                from llm_np_cp_tpu.cache import quantize_kv
+
+                pages, scales = quantize_kv(pages)
+                kwargs = dict(k_scale=scales, v_scale=scales)
+            np.asarray(ragged_paged_attention(
+                q, pages, pages, tables, tile_row, tile_qpos0, tile_qlen,
+                pads, jnp.int32(1 << 30), scale=0.125, interpret=False,
+                **kwargs,
+            ))
         else:
             raise ValueError(f"unknown kernel {kernel!r}")
     except Exception as e:  # noqa: BLE001 — any compile/runtime error gates
@@ -135,6 +168,17 @@ def paged_kernel_name(int8_cache: bool) -> str:
     return (
         "paged_decode_attention_int8" if int8_cache
         else "paged_decode_attention"
+    )
+
+
+def ragged_kernel_name(int8_cache: bool) -> str:
+    """Probe/kernel name for the mixed prefill+decode ragged kernel
+    (the unified-tick dispatch) — same one-rule discipline as
+    ``paged_kernel_name``, shared by the engine's ``mixed_step`` gate
+    and the CLI's pre-build check."""
+    return (
+        "ragged_paged_attention_int8" if int8_cache
+        else "ragged_paged_attention"
     )
 
 

@@ -44,6 +44,21 @@ fully-filled leading blocks are looked up in a refcounted registry
 (serve/prefix_cache.py); hits are claimed into the request's block table
 and their prefill chunks are SKIPPED — only the shared K/V is copied
 into the temp prefill cache so the remaining chunks attend correctly.
+
+Unified tick (``mixed_step="on"/"auto"``): the phase-split pipeline
+above collapses into ONE jit-stable ``mixed_step`` dispatch per tick —
+a packed ragged batch of prefill chunk slices and decode rows runs
+through a single layer scan that threads the pool slabs, scatters every
+token's K/V straight into its pool block (NO temp prefill cache, NO
+``gather_prefix`` copy program — shared prefix blocks are attended
+in place through the block table), and attends via
+``ragged_paged_attention`` (probe-gated; XLA gather fallback).  The
+scheduler's token-budget planner (``Scheduler.plan_tick``) co-schedules
+chunked prefill with decode under ``tick_token_budget`` tokens per tick
+— decode rows first, so a long prefill can no longer stall the decoding
+batch (the PR-5 trace finding).  The packed width is bucketed
+(``mixed_buckets``), so the program compiles once per bucket and NEVER
+per tick, whatever the prefill:decode row mix (compile-counter lint).
 """
 
 from __future__ import annotations
@@ -164,18 +179,54 @@ class ServeEngine:
         clock: Callable[[], float] = time.perf_counter,
         fault_injector: FaultInjector | None = None,
         tracer: TraceRecorder | None = None,
+        mixed_step: str = "off",
+        tick_token_budget: int | None = None,
     ) -> None:
         if decode_attn_impl not in ("xla", "flash_decode", "paged"):
             raise ValueError(
                 f"decode_attn_impl must be 'xla', 'flash_decode' or "
                 f"'paged', got {decode_attn_impl!r}"
             )
-        from llm_np_cp_tpu.ops.pallas.support import gate_attn_impl
+        if mixed_step not in ("auto", "on", "off"):
+            raise ValueError(
+                f"mixed_step must be 'auto', 'on' or 'off', got "
+                f"{mixed_step!r}"
+            )
+        from llm_np_cp_tpu.ops.pallas.support import (
+            gate_attn_impl,
+            kernel_error,
+            ragged_kernel_name,
+        )
 
+        int8_cache = jnp.dtype(cache_dtype) == jnp.int8
         decode_attn_impl = gate_attn_impl(
-            decode_attn_impl, int8_cache=jnp.dtype(cache_dtype) == jnp.int8
+            decode_attn_impl, int8_cache=int8_cache
         )
         self.decode_attn_impl = decode_attn_impl  # post-gate (tests/CLI)
+        # -- unified-tick gate: "on" forces the unified tick (XLA ragged
+        # fallback if Mosaic rejects the kernel), "auto" takes it only
+        # when the ragged kernel probe passes (conservative: a broken
+        # Mosaic toolchain keeps the battle-tested phase-split path),
+        # "off" is the phase-split engine
+        self.mixed_step_mode = mixed_step
+        self.ragged_attn_impl: str | None = None
+        if mixed_step == "off":
+            self.mixed = False
+        else:
+            err = kernel_error(ragged_kernel_name(int8_cache))
+            if err is None:
+                self.mixed, self.ragged_attn_impl = True, "pallas"
+            elif mixed_step == "on":
+                import logging
+
+                logging.getLogger("llm_np_cp_tpu").warning(
+                    "mixed_step='on' with the ragged kernel unavailable "
+                    "(%s); the unified tick will use the XLA gather "
+                    "fallback attention", err,
+                )
+                self.mixed, self.ragged_attn_impl = True, "xla"
+            else:
+                self.mixed = False
         # seeded chaos schedule (serve/faults.py); None = every injection
         # point is a single is-None check (zero overhead)
         self.faults = fault_injector
@@ -227,13 +278,69 @@ class ServeEngine:
         # live (queued or running) requests by id — the abort/deadline
         # index; entries leave on finish and abort
         self._requests: dict[int, Request] = {}
+        # device dispatches issued by this engine (every jitted-step
+        # call) — the CPU-measurable observable for the unified tick's
+        # "strictly fewer dispatches per tick" claim
+        self.n_dispatches = 0
 
-        # -- jitted programs (fixed set; tick loop never adds more) ----
-        self._prefill_step = make_ragged_prefill_step(config)
-        self._decode_step = self._make_decode_step(decode_attn_impl)
-        self._sample_first = self._make_sample_first()
-        self._scatter_prefill = self._make_scatter_prefill()
-        self._gather_prefix = self._make_gather_prefix()
+        if self.mixed:
+            # -- unified tick: ONE jitted program, bucketed packed width.
+            # The temp prefill cache, scatter_prefill, gather_prefix and
+            # sample_first programs of the phase-split path do not exist
+            # in this mode — prefill K/V goes straight into pool blocks
+            # and sampling happens inside the mixed step.
+            from llm_np_cp_tpu.ops.pallas.decode_attention import (
+                RAGGED_Q_TILE,
+            )
+
+            self._q_tile = RAGGED_Q_TILE
+            budget = tick_token_budget or (
+                max_slots + 2 * self.prefill_chunk
+            )
+            if budget < max_slots:
+                raise ValueError(
+                    f"tick_token_budget ({budget}) must be >= max_slots "
+                    f"({max_slots}): every decode row needs one token per "
+                    "tick before prefill fills the remainder"
+                )
+            self.tick_token_budget = budget
+            self.mixed_buckets = self._make_buckets(budget, max_slots)
+            self._mixed_step = self._make_mixed_step()
+        else:
+            self.tick_token_budget = 0
+            self.mixed_buckets: tuple[int, ...] = ()
+            # -- jitted programs (fixed set; tick loop never adds more)
+            self._prefill_step = make_ragged_prefill_step(config)
+            self._decode_step = self._make_decode_step(decode_attn_impl)
+            self._sample_first = self._make_sample_first()
+            self._scatter_prefill = self._make_scatter_prefill()
+            self._gather_prefix = self._make_gather_prefix()
+
+    def _make_buckets(self, budget: int, max_slots: int) -> tuple[int, ...]:
+        """Packed-width buckets for the mixed step: a doubling ladder of
+        q-tile multiples capped by the worst aligned total (every planned
+        token plus per-row tile padding).  The mixed step compiles once
+        per bucket actually used — never per tick, never per
+        prefill:decode composition (compile-counter lint)."""
+        qb = self._q_tile
+        # each of up to max_slots segments wastes < qb lanes to alignment
+        a_max = _ceil_to(budget + max_slots * (qb - 1), qb)
+        buckets = []
+        t = qb
+        while t < a_max:
+            buckets.append(t)
+            t *= 2
+        buckets.append(a_max)
+        return tuple(sorted(set(buckets)))
+
+    def _pick_bucket(self, n: int) -> int:
+        for t in self.mixed_buckets:
+            if t >= n:
+                return t
+        raise AssertionError(
+            f"planner produced {n} aligned tokens > largest bucket "
+            f"{self.mixed_buckets[-1]} — budget accounting is broken"
+        )
 
     # ------------------------------------------------------------------
     def _prefill_width(self, req: Request) -> int:
@@ -282,12 +389,19 @@ class ServeEngine:
         """Compiled-program count per jitted step (the static-shape
         contract: decode/prefill/sample stay at 1; scatter grows once per
         distinct prefill block count).  tools/compile_counter.py wraps
-        this for the CI check."""
+        this for the CI check.
+
+        Unified-tick engines report ONE program — ``mixed_step``, one
+        compile per packed-width bucket — and none of the phase-split
+        programs exist (the ``gather_prefix`` copy in particular is
+        deleted, pinned by the lint)."""
 
         def size(fn: Any) -> int:
             get = getattr(fn, "_cache_size", None)
             return int(get()) if get is not None else -1
 
+        if self.mixed:
+            return {"mixed_step": size(self._mixed_step)}
         return {
             "prefill_step": size(self._prefill_step),
             "decode_step": size(self._decode_step),
@@ -600,6 +714,149 @@ class ServeEngine:
 
         return decode_step
 
+    def _make_mixed_step(self) -> Callable:
+        """The unified-tick program: ONE dispatch runs a packed ragged
+        batch of prefill chunk slices (q_len up to ``prefill_chunk``)
+        and decode rows (q_len 1) through the layer scan, scattering
+        every token's K/V straight into its pool block and attending
+        through the block tables — no temp prefill cache, no
+        ``gather_prefix`` copy (shared prefix blocks are read in place),
+        no separate sample dispatch (logits are gathered at each row's
+        last packed token and sampled in-graph with the SAME
+        (seed, content position) key derivation as both split-path
+        samplers, so tokens are impl- and preemption-invariant).
+
+        Shapes are static per packed-width bucket: [T] token-level
+        operands, [T/q_tile] tile metadata for the ragged kernel,
+        [max_slots] row-level operands.  One compile per bucket, zero
+        per tick (tools/compile_counter lint)."""
+        from llm_np_cp_tpu.ops.pallas.decode_attention import (
+            ragged_paged_attention,
+            ragged_paged_attention_xla,
+        )
+
+        config, sampler = self.config, self.sampler
+        quantized = self.cache_dtype == jnp.int8
+        win = config.sliding_window
+        num_layers = config.num_hidden_layers
+        use_kernel = self.ragged_attn_impl == "pallas"
+        big_win = jnp.int32(1 << 30)
+
+        @partial(jax.jit, donate_argnums=(1,))
+        def mixed_step(
+            params: Params,
+            pages: PagedKV,
+            tokens: jnp.ndarray,      # [T] int32 packed input ids
+            positions: jnp.ndarray,   # [T] int32 content positions (RoPE)
+            tok_blk: jnp.ndarray,     # [T] int32 pool block per token
+            tok_off: jnp.ndarray,     # [T] int32 in-block slot per token
+            tok_row: jnp.ndarray,     # [T] int32 owning engine row
+            tok_slot: jnp.ndarray,    # [T] int32 cache slot per token
+            tok_live: jnp.ndarray,    # [T] bool (False = packing lane)
+            tile_row: jnp.ndarray,    # [T/QB] int32
+            tile_qpos0: jnp.ndarray,  # [T/QB] int32
+            tile_qlen: jnp.ndarray,   # [T/QB] int32
+            tables: jnp.ndarray,      # [R, MB] int32 (scratch-0 padded)
+            pads: jnp.ndarray,        # [R] int32
+            last_idx: jnp.ndarray,    # [R] int32 packed idx of sample tok
+            sample_pos: jnp.ndarray,  # [R] int32 content pos of that tok
+            seeds: jnp.ndarray,       # [R] uint32
+        ):
+            x = embed_inputs(params, tokens[None, :], config)  # [1, T, H]
+            cos, sin = rope_cos_sin(
+                positions[None, :], config, dtype=jnp.float32
+            )
+            act = ACT2FN[config.hidden_act]
+            is_sliding = jnp.array(
+                [config.layer_is_sliding(i) for i in range(num_layers)],
+                dtype=jnp.bool_,
+            )
+
+            def layer_step(x: jnp.ndarray, xs: tuple) -> tuple:
+                if quantized:
+                    w, kp, vp, ksp, vsp, sliding = xs
+                else:
+                    w, kp, vp, sliding = xs
+
+                def kv_update(k, v):  # fresh projections [1, T, K, D]
+                    # dead lanes all write (scratch block 0, slot 0) —
+                    # duplicate scatter indices there are harmless
+                    if quantized:
+                        kq, ks = quantize_kv(k)
+                        vq, vs = quantize_kv(v)
+                        return (
+                            (kp.at[tok_blk, tok_off].set(kq[0]),
+                             ksp.at[tok_blk, tok_off].set(ks[0])),
+                            (vp.at[tok_blk, tok_off].set(vq[0]),
+                             vsp.at[tok_blk, tok_off].set(vs[0])),
+                        )
+                    return (
+                        kp.at[tok_blk, tok_off].set(k[0]),
+                        vp.at[tok_blk, tok_off].set(v[0]),
+                    )
+
+                def attn_fn(q, k_att, v_att, sliding_l):
+                    if quantized:
+                        (kp2, ksp2), (vp2, vsp2) = k_att, v_att
+                    else:
+                        kp2, vp2 = k_att, v_att
+                        ksp2 = vsp2 = None
+                    win_eff = (
+                        jnp.where(sliding_l, jnp.int32(win), big_win)
+                        if win is not None else big_win
+                    )
+                    if use_kernel:
+                        out = ragged_paged_attention(
+                            q[0], kp2, vp2, tables, tile_row,
+                            tile_qpos0, tile_qlen, pads, win_eff,
+                            k_scale=ksp2, v_scale=vsp2,
+                            scale=config.attn_scale,
+                            logit_softcap=config.attn_logit_softcapping,
+                        )
+                    else:
+                        out = ragged_paged_attention_xla(
+                            q[0], kp2, vp2, tables, tok_row, tok_slot,
+                            tok_live, pads, win_eff,
+                            k_scale=ksp2, v_scale=vsp2,
+                            scale=config.attn_scale,
+                            logit_softcap=config.attn_logit_softcapping,
+                        )
+                    return out[None]
+
+                x, kv_att, _, _ = run_decoder_layer(
+                    w, x, config=config, act=act, cos=cos, sin=sin,
+                    sliding=sliding, kv_update=kv_update, attn_fn=attn_fn,
+                )
+                if quantized:
+                    (kp2, ksp2), (vp2, vsp2) = kv_att
+                    return x, (kp2, vp2, ksp2, vsp2)
+                return x, kv_att
+
+            xs: tuple = (params["layers"], pages.k, pages.v)
+            if quantized:
+                xs += (pages.k_scale, pages.v_scale)
+            xs += (is_sliding,)
+            x, ys = lax.scan(layer_step, x, xs, unroll=scan_unroll(config))
+            new_pages = PagedKV(
+                k=ys[0], v=ys[1],
+                k_scale=ys[2] if quantized else None,
+                v_scale=ys[3] if quantized else None,
+            )
+            # logits ONLY at each row's sampled token (decode rows and
+            # prefill segments; rows with nothing to sample point at
+            # packed index 0 and their draw is discarded host-side)
+            xr = x[0][last_idx]  # [R, H]
+            logits = final_logits(params, xr[:, None, :], config)[:, 0]
+            keys = jax.vmap(
+                lambda s, t: jax.random.fold_in(jax.random.PRNGKey(s), t)
+            )(seeds, sample_pos)
+            nxt = jax.vmap(lambda k, lg: sampler(k, lg[None])[0])(
+                keys, logits
+            )
+            return nxt, new_pages
+
+        return mixed_step
+
     # ------------------------------------------------------------------
     # Request lifecycle
     # ------------------------------------------------------------------
@@ -820,10 +1077,19 @@ class ServeEngine:
             clock=self.clock,
             fault_injector=self.faults,
             tracer=self.tracer,
+            mixed_step=self.mixed_step_mode,
+            tick_token_budget=self.tick_token_budget or None,
         )
         eng.metrics = self.metrics
         eng.decode_degraded = self.decode_degraded
         eng._next_id = self._next_id
+        if self.mixed:
+            if eng.mixed and eng.ragged_attn_impl == self.ragged_attn_impl:
+                # same resolution → identical jaxpr; a runtime-degraded
+                # process (disable_kernel) rebuilds on the XLA fallback
+                # and compiles it once there, not per restart
+                eng._mixed_step = self._mixed_step
+            return eng
         names = ["_prefill_step", "_sample_first", "_scatter_prefill",
                  "_gather_prefix"]
         if eng.decode_attn_impl == self.decode_attn_impl:
@@ -958,6 +1224,7 @@ class ServeEngine:
 
         cache = KVCache.init(self.config, 1, cap, dtype=self.cache_dtype)
         if n_shared:
+            self.n_dispatches += 1
             cache = self._gather_prefix(
                 cache, self.pool.pages,
                 jnp.asarray(np.asarray(req.block_ids[:n_shared], np.int32)),
@@ -970,6 +1237,7 @@ class ServeEngine:
             # mutes a zombie engine by clearing the attribute
             t_chunk = (self.tracer.now_us()
                        if self.tracer is not None else -1.0)
+            self.n_dispatches += 1
             with (jax.profiler.TraceAnnotation("serve.prefill_chunk")
                   if self.tracer is not None else _NULL_CTX):
                 last, cache = self._prefill_step(
@@ -986,6 +1254,7 @@ class ServeEngine:
                         "rid": req.req_id, "offset": off,
                         "width": end - off,
                     })
+        self.n_dispatches += 1
         self.pool.pages = self._scatter_prefill(
             self.pool.pages, cache,
             jnp.asarray(np.asarray(req.block_ids[n_shared:], dtype=np.int32)),
@@ -1000,6 +1269,7 @@ class ServeEngine:
             # registered — register only LRU-touches them)
             pc.register(keys, req.block_ids[: len(keys)])
             self.metrics.on_prefix(requested=len(keys), hits=n_shared)
+        self.n_dispatches += 1
         tok = self._sample_first(
             last,
             jnp.uint32(req.seed),
@@ -1009,7 +1279,16 @@ class ServeEngine:
 
     # ------------------------------------------------------------------
     def step(self) -> bool:
-        """One scheduler tick: deadline sweep, admissions (+prefill),
+        """One scheduler tick; returns True while work remains.  Unified
+        engines (``mixed_step``) run the single-dispatch mixed tick,
+        phase-split engines the admission→prefill→grow→decode pipeline
+        below."""
+        if self.mixed:
+            return self._step_mixed()
+        return self._step_split()
+
+    def _step_split(self) -> bool:
+        """One phase-split tick: deadline sweep, admissions (+prefill),
         then one packed decode dispatch.  Returns True while work
         remains.
 
@@ -1112,6 +1391,341 @@ class ServeEngine:
             })
         return self.scheduler.has_work
 
+    # ------------------------------------------------------------------
+    # Unified tick (mixed_step)
+    # ------------------------------------------------------------------
+    def _init_mixed_prefill(self, req: Request) -> None:
+        """Admission bookkeeping for the unified tick: fix the request's
+        left-pad and prefill target, pre-mark prefix-cache-covered
+        content as done (covered chunks consume NO tick budget and are
+        attended in place through the block table — no gather_prefix
+        copy), and stash the teacher-forced content for the packer."""
+        content = req.effective_prompt()
+        w = self._prefill_width(req)
+        req.pad = w - content.size
+        shared_slots = req.n_shared_blocks * self.block_size
+        req.prefill_target = int(content.size)
+        req.prefill_done = max(shared_slots - req.pad, 0)
+        req.prefilled = False
+        req.extra["prefill_content"] = content
+
+    def _pack_mixed(
+        self,
+        decode_rows: list[Request],
+        prefill_segs: list[tuple[Request, int]],
+    ) -> tuple:
+        """Build the mixed step's packed operands from the planner's
+        verdict.  Each row's token segment lands at consecutive,
+        q-tile-aligned packed positions (dead alignment lanes point at
+        the scratch block and are masked); the packed width is the
+        smallest bucket covering the aligned total, so the dispatch
+        reuses a warm compile whatever the prefill:decode mix."""
+        qb = self._q_tile
+        b = self.scheduler.max_slots
+        mb = self.max_blocks_per_seq
+        bs = self.block_size
+        segs: list[tuple[Request, np.ndarray, int, bool]] = []
+        for r in decode_rows:
+            segs.append((
+                r, np.asarray([r.generated[-1]], np.int32),
+                r.cache_len - 1, True,
+            ))
+        for r, n in prefill_segs:
+            content = r.extra["prefill_content"]
+            toks = np.asarray(
+                content[r.prefill_done:r.prefill_done + n], np.int32
+            )
+            segs.append((
+                r, toks, r.pad + r.prefill_done,
+                r.prefill_done + n >= r.prefill_target,
+            ))
+        aligned = sum(_ceil_to(t.size, qb) for _, t, _, _ in segs)
+        t_w = self._pick_bucket(max(aligned, qb))
+        nt = t_w // qb
+        tokens = np.zeros(t_w, np.int32)
+        positions = np.zeros(t_w, np.int32)
+        tok_blk = np.zeros(t_w, np.int32)
+        tok_off = np.zeros(t_w, np.int32)
+        tok_row = np.zeros(t_w, np.int32)
+        tok_slot = np.zeros(t_w, np.int32)
+        tok_live = np.zeros(t_w, bool)
+        tile_row = np.zeros(nt, np.int32)
+        tile_qpos0 = np.zeros(nt, np.int32)
+        tile_qlen = np.zeros(nt, np.int32)
+        tables = np.zeros((b, mb), np.int32)
+        pads = np.zeros(b, np.int32)
+        last_idx = np.zeros(b, np.int32)
+        sample_pos = np.zeros(b, np.int32)
+        seeds = np.zeros(b, np.uint32)
+        cur = 0
+        for r, toks, start_slot, samples in segs:
+            n = toks.size
+            slot = r.slot
+            tables[slot, :len(r.block_ids)] = r.block_ids
+            pads[slot] = r.pad
+            seeds[slot] = np.uint32(r.seed)
+            sl = start_slot + np.arange(n, dtype=np.int32)
+            tokens[cur:cur + n] = toks
+            positions[cur:cur + n] = sl - r.pad
+            blocks = np.asarray(r.block_ids, np.int32)
+            tok_blk[cur:cur + n] = blocks[sl // bs]
+            tok_off[cur:cur + n] = sl % bs
+            tok_row[cur:cur + n] = slot
+            tok_slot[cur:cur + n] = sl
+            tok_live[cur:cur + n] = True
+            n_tiles = -(-n // qb)
+            ti0 = cur // qb
+            for k in range(n_tiles):
+                tile_row[ti0 + k] = slot
+                tile_qpos0[ti0 + k] = start_slot + k * qb
+                tile_qlen[ti0 + k] = min(qb, n - k * qb)
+            if samples:
+                last_idx[slot] = cur + n - 1
+                sample_pos[slot] = int(sl[-1]) - r.pad
+            cur += n_tiles * qb
+        return tuple(jnp.asarray(a) for a in (
+            tokens, positions, tok_blk, tok_off, tok_row, tok_slot,
+            tok_live, tile_row, tile_qpos0, tile_qlen, tables, pads,
+            last_idx, sample_pos, seeds,
+        ))
+
+    def _finish_mixed_prefill(self, req: Request, tok: int) -> None:
+        """A row's prefill reached its target this tick: register its
+        prompt blocks with the prefix cache (they are already IN the
+        pool — direct writes, nothing to copy) and emit the first
+        token sampled by the same dispatch."""
+        req.prefilled = True
+        req.extra.pop("prefill_content", None)
+        pc = self.pool.prefix_cache
+        keys = req.extra.pop("prefix_keys", None)
+        req.extra.pop("prefix_keys_width", None)
+        if pc is not None and keys:
+            pc.register(keys, req.block_ids[: len(keys)])
+            self.metrics.on_prefix(
+                requested=len(keys), hits=req.n_shared_blocks
+            )
+        self._emit(req, tok)
+        if not self._maybe_finish(req) and self.tracer is not None:
+            self.tracer.request_phase(req.req_id, "decode")
+
+    def _step_mixed(self) -> bool:
+        """One unified tick: deadline sweep + admission, block growth,
+        token-budget planning, then ONE mixed ragged dispatch covering
+        every planned prefill chunk slice and decode row.  Phase slices
+        (``admission`` / ``grow`` / ``plan`` / ``mixed_dispatch`` /
+        ``host_sync`` / ``deliver``, serve/tracing.MIXED_TICK_PHASES)
+        keep the consecutive-timestamps sum-to-tick invariant; the tick
+        args additionally carry the prefill/decode token split so
+        tools/summarize_trace.py can report mixed-step utilization.
+        ``self.tracer`` is re-read at every hook for the same
+        zombie-mute reason as the split tick."""
+        t0 = self.tracer.now_us() if self.tracer is not None else -1.0
+        self._sweep_deadlines()
+        admitted = self.scheduler.admit()
+        for req in admitted:
+            if req.admit_time is None:
+                req.admit_time = self.clock()
+            self._init_mixed_prefill(req)
+            if self.tracer is not None:
+                self.tracer.request_phase(req.req_id, "prefill", args={
+                    "shared_blocks": req.n_shared_blocks,
+                    "preemptions": req.n_preemptions,
+                })
+        t1 = self.tracer.now_us() if self.tracer is not None else -1.0
+
+        for req in self.scheduler.ensure_decode_blocks():
+            if self.tracer is not None:
+                self.tracer.request_instant(req.req_id, "evicted-requeued")
+                self.tracer.request_phase(req.req_id, "queued")
+            self._emit_event(req, "evicted-requeued")
+        t2 = self.tracer.now_us() if self.tracer is not None else -1.0
+
+        decode_rows, prefill_segs = self.scheduler.plan_tick(
+            self.tick_token_budget, self.prefill_chunk
+        )
+        t3 = self.tracer.now_us() if self.tracer is not None else -1.0
+
+        t4 = t5 = t3
+        n_prefill_tok = sum(n for _, n in prefill_segs)
+        n_decode_tok = len(decode_rows)
+        if decode_rows or prefill_segs:
+            args = self._pack_mixed(decode_rows, prefill_segs)
+            td0 = self.clock()
+            with (jax.profiler.TraceAnnotation("serve.mixed_dispatch")
+                  if self.tracer is not None else _NULL_CTX):
+                nxt, self.pool.pages = self._dispatch_mixed(
+                    args, bool(prefill_segs)
+                )
+            t4 = self.tracer.now_us() if self.tracer is not None else -1.0
+            nxt_host = np.asarray(nxt)
+            t5 = self.tracer.now_us() if self.tracer is not None else -1.0
+            if n_prefill_tok:
+                # per-request prefill time: the dispatch+sync wall split
+                # by token share (the mixed analogue of Request.prefill_s)
+                per_tok = (self.clock() - td0) / (
+                    n_prefill_tok + n_decode_tok
+                )
+                for r, n in prefill_segs:
+                    r.prefill_s += per_tok * n
+            for r, n in prefill_segs:
+                r.prefill_done += n
+                if r.prefill_done >= r.prefill_target:
+                    self._finish_mixed_prefill(r, int(nxt_host[r.slot]))
+            for r in decode_rows:
+                self._emit(r, int(nxt_host[r.slot]))
+                self._maybe_finish(r)
+
+        active = n_decode_tok + len(prefill_segs)
+        self.metrics.on_tick(
+            queue_depth=self.scheduler.queue_depth,
+            occupancy=self.pool.occupancy,
+            active_slots=active,
+            preemptions_total=self.scheduler.n_preemptions,
+            kv_bytes=(
+                self._kv_bytes_tick_mixed(decode_rows, prefill_segs)
+                if active else 0
+            ),
+            prefill_tokens=n_prefill_tok,
+            decode_tokens=n_decode_tok,
+        )
+        if self.tracer is not None and t0 >= 0.0:
+            t6 = self.tracer.now_us()
+            self.tracer.tick(t0, (
+                ("admission", t0, t1), ("grow", t1, t2),
+                ("plan", t2, t3), ("mixed_dispatch", t3, t4),
+                ("host_sync", t4, t5), ("deliver", t5, t6),
+            ), args={
+                "active_slots": active,
+                "queue_depth": self.scheduler.queue_depth,
+                "admitted": len(admitted),
+                "prefill_tokens": n_prefill_tok,
+                "decode_tokens": n_decode_tok,
+            })
+        return self.scheduler.has_work
+
+    def _dispatch_mixed(self, args: tuple, has_prefill: bool) -> tuple:
+        """One mixed dispatch with the split path's runtime-degradation
+        contract: a ragged-kernel dispatch fault permanently falls back
+        to the XLA ragged attention for the process and retries the same
+        tick; on the XLA fallback there is nothing left to degrade to,
+        so faults propagate to the supervisor.  Chaos sites: ``prefill``
+        fires when the tick planned prefill tokens, ``decode`` at every
+        dispatch (it IS the decode dispatch)."""
+        faults = self.faults
+        if faults is not None:
+            if has_prefill and faults.trip("prefill") is not None:
+                raise FaultInjected("prefill")
+            if (
+                faults.trip("decode") is not None
+                and not self._degrade_mixed(
+                    "chaos: injected mixed-dispatch fault"
+                )
+            ):
+                raise FaultInjected("decode")
+        self.n_dispatches += 1
+        try:
+            return self._mixed_step(self.params, self.pool.pages, *args)
+        except Exception as e:  # noqa: BLE001 — any dispatch fault gates
+            if not self._degrade_mixed(f"{type(e).__name__}: {e}"):
+                raise
+            # same donated-pages caveat as the split path's retry
+            self.n_dispatches += 1
+            return self._mixed_step(self.params, self.pool.pages, *args)
+
+    def _degrade_mixed(self, reason: str) -> bool:
+        """Pallas ragged attention → XLA fallback, process-wide (the
+        paged decode step's degradation discipline applied to the
+        unified tick).  Returns False when already on the fallback."""
+        if self.ragged_attn_impl != "pallas":
+            return False
+        from llm_np_cp_tpu.ops.pallas.support import (
+            disable_kernel,
+            ragged_kernel_name,
+        )
+
+        disable_kernel(
+            ragged_kernel_name(self.cache_dtype == jnp.int8), reason
+        )
+        self.decode_degraded = reason
+        self.ragged_attn_impl = "xla"
+        self._mixed_step = self._make_mixed_step()
+        return True
+
+    def _kv_bytes_tick_mixed(
+        self,
+        decode_rows: list[Request],
+        prefill_segs: list[tuple[Request, int]],
+    ) -> int:
+        """K/V bytes this mixed tick's attention touches.  The ragged
+        kernel streams each q tile's visible blocks (window-aware per
+        layer); the XLA fallback materializes every token's full padded
+        row view, counted as such."""
+        cfg = self.config
+        item = self.cache_dtype.itemsize
+        per_slot = cfg.num_key_value_heads * cfg.head_dim * item * 2
+        if self.cache_dtype == jnp.int8:
+            per_slot += cfg.num_key_value_heads * 4 * 2
+        n_layers = cfg.num_hidden_layers
+        qb = self._q_tile
+        if self.ragged_attn_impl != "pallas":
+            toks = len(decode_rows) + sum(
+                -(-n // qb) * qb for _, n in prefill_segs
+            )
+            return toks * self.max_seq_len * n_layers * per_slot
+        win = cfg.sliding_window
+        n_sliding = (
+            sum(cfg.layer_is_sliding(i) for i in range(n_layers))
+            if win is not None else 0
+        )
+        bs = self.block_size
+
+        def tile_slots(pad: int, qpos0: int, qlast: int) -> tuple[int, int]:
+            full = (qlast // bs - pad // bs + 1) * bs
+            if not n_sliding:
+                return full, 0
+            lo = max(pad, qpos0 - win + 1)
+            return full, (qlast // bs - lo // bs + 1) * bs
+
+        slot_layers = 0
+        for r in decode_rows:
+            s = r.cache_len - 1
+            g_full, g_win = tile_slots(r.pad, s, s)
+            slot_layers += (n_layers - n_sliding) * g_full + n_sliding * g_win
+        for r, n in prefill_segs:
+            start = r.pad + r.prefill_done
+            for k in range(-(-n // qb)):
+                q0 = start + k * qb
+                ql = min(qb, n - k * qb)
+                g_full, g_win = tile_slots(r.pad, q0, q0 + ql - 1)
+                slot_layers += (
+                    (n_layers - n_sliding) * g_full + n_sliding * g_win
+                )
+        return slot_layers * per_slot
+
+    def _warm_mixed_bucket(self, t_w: int) -> None:
+        """Compile one packed-width bucket with an all-dead batch: every
+        lane points at the scratch block and is fully masked, so the
+        only effect is the compile (and a garbage write to scratch)."""
+        qb = self._q_tile
+        b = self.scheduler.max_slots
+        mb = self.max_blocks_per_seq
+        zeros = (
+            np.zeros(t_w, np.int32), np.zeros(t_w, np.int32),
+            np.zeros(t_w, np.int32), np.zeros(t_w, np.int32),
+            np.zeros(t_w, np.int32), np.zeros(t_w, np.int32),
+            np.zeros(t_w, bool),
+            np.zeros(t_w // qb, np.int32), np.zeros(t_w // qb, np.int32),
+            np.zeros(t_w // qb, np.int32),
+            np.zeros((b, mb), np.int32), np.zeros(b, np.int32),
+            np.zeros(b, np.int32), np.zeros(b, np.int32),
+            np.zeros(b, np.uint32),
+        )
+        nxt, self.pool.pages = self._mixed_step(
+            self.params, self.pool.pages,
+            *(jnp.asarray(a) for a in zeros),
+        )
+        np.asarray(nxt)  # block until the compile lands
+
     def _dispatch_decode(self, *args: jnp.ndarray) -> tuple:
         """One decode dispatch with runtime kernel degradation: if the
         paged step faults at dispatch time (an injected chaos fault or a
@@ -1128,11 +1742,13 @@ class ServeEngine:
                                          "fault")
         ):
             raise FaultInjected("decode")
+        self.n_dispatches += 1
         try:
             return self._decode_step(self.params, self.pool.pages, *args)
         except Exception as e:  # noqa: BLE001 — any dispatch fault gates
             if not self._degrade_decode(f"{type(e).__name__}: {e}"):
                 raise
+            self.n_dispatches += 1
             # the paged step donated the pool pages; if the fault struck
             # after they were consumed this retry raises on the deleted
             # buffers and the supervisor restart (which rebuilds the
@@ -1229,6 +1845,18 @@ class ServeEngine:
         self.submit(np.ones(min(prompt_lens), np.int32),
                     min(2, max_new_tokens))
         self.run_until_complete()
+        if self.mixed:
+            # one compile per packed-width bucket — the dummy request
+            # covered whichever buckets its own ticks picked; warm the
+            # rest directly so mid-traffic composition churn can never
+            # trigger a compile stall
+            for t_w in self.mixed_buckets:
+                self._warm_mixed_bucket(t_w)
+            if self.pool.prefix_cache is not None:
+                self.pool.prefix_cache.clear()
+            self.scheduler.finished.clear()
+            self.metrics = ServeMetrics(clock=self.clock)
+            return
         b_max = min(
             self.pool.blocks_for(_ceil_to(
                 max(prompt_lens) + max_new_tokens - 1, self.prefill_chunk

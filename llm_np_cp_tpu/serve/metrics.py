@@ -125,6 +125,10 @@ class ServeMetrics:
         self.kv_bytes_tick: list[float] = []
         self.prefix_blocks_requested = 0
         self.prefix_blocks_hit = 0
+        # unified-tick (mixed_step) utilization: how this engine's token
+        # budget was actually spent — exact counters, never trimmed
+        self.mixed_prefill_tokens = 0
+        self.mixed_decode_tokens = 0
 
     # -- record hooks (engine calls these) -----------------------------
     def on_submit(self, req: Request) -> None:
@@ -157,8 +161,11 @@ class ServeMetrics:
     def on_tick(
         self, *, queue_depth: int, occupancy: float, active_slots: int,
         preemptions_total: int, kv_bytes: int = 0,
+        prefill_tokens: int = 0, decode_tokens: int = 0,
     ) -> None:
         with self._lock:
+            self.mixed_prefill_tokens += prefill_tokens
+            self.mixed_decode_tokens += decode_tokens
             self.n_ticks += 1
             self.t_last = self.clock()
             self.queue_depth.append(queue_depth)
@@ -259,6 +266,8 @@ class ServeMetrics:
             kvb = list(self.kv_bytes_tick)
             prefix_req = self.prefix_blocks_requested
             prefix_hit = self.prefix_blocks_hit
+            out["mixed_prefill_tokens"] = self.mixed_prefill_tokens
+            out["mixed_decode_tokens"] = self.mixed_decode_tokens
         out.update(_pcts(ttft, "ttft_s"))
         out.update(_pcts(decode, "decode_tok_s"))
         out.update(_pcts(qwait, "queue_wait_s"))
@@ -349,6 +358,10 @@ class ServeMetrics:
         emit("kv_bytes_tick_mean", "gauge",
              "Mean K/V bytes decode attention touches per tick",
              [("", s.get("kv_bytes_tick_mean", 0.0))])
+        emit("mixed_tokens_total", "counter",
+             "Unified-tick token budget spent, split by work kind",
+             [('{kind="prefill"}', s["mixed_prefill_tokens"]),
+              ('{kind="decode"}', s["mixed_decode_tokens"])])
         emit("throughput_tok_s", "gauge",
              "Generated tokens per second over the traffic span",
              [("", s["throughput_tok_s"])])

@@ -101,6 +101,15 @@ class Request:
     # K/V is already in the pool; the engine skips those prefill chunks)
     n_shared_blocks: int = 0
     pad: int = 0  # left-pad slots in this request's cache region
+    # -- unified-tick (mixed_step) prefill progress -------------------
+    # content tokens whose K/V is already in the pool this admission
+    # (prefix-cache hits pre-seed it — covered content never consumes
+    # tick budget), the content length this admission must reach, and
+    # the completion flag the planner keys on.  The phase-split engine
+    # leaves these untouched; a preemption resets them with pad.
+    prefill_done: int = 0
+    prefill_target: int = 0
+    prefilled: bool = False
     slot: int = -1  # decode slot while RUNNING
     n_preemptions: int = 0
     # -- metrics timestamps -------------------------------------------
@@ -246,6 +255,46 @@ class Scheduler:
         return admitted
 
     # ------------------------------------------------------------------
+    def plan_tick(
+        self, budget: int, max_chunk: int,
+    ) -> tuple[list[Request], list[tuple[Request, int]]]:
+        """The unified-tick token-budget planner: split this tick's
+        ``budget`` tokens between decode rows and prefill chunk slices.
+
+        Returns ``(decode_rows, prefill_segments)`` where each segment is
+        ``(request, n_tokens)``.  Policy (the SLO-aware co-schedule):
+
+        - **decode first, never starved**: every running request that
+          has finished prefill gets its one decode token before any
+          prefill work is budgeted — a long prefill can no longer stall
+          the decoding batch, it only fills the REMAINING budget.
+        - **prefill fills the rest, oldest first**: mid-prefill rows
+          (admission order, so FIFO completion order is preserved) take
+          up to ``max_chunk`` tokens each from what is left.  Token
+          granularity: a segment smaller than a full chunk is legal, so
+          any ``budget >= max_slots`` guarantees forward progress.
+        - **budgets are exact**: the planned token count never exceeds
+          ``budget`` (pinned by tests/test_serve_scheduler.py).
+        - **prefix-cache hits are free**: covered content was pre-marked
+          done at admission (``Request.prefill_done``), so shared blocks
+          consume zero budget — the cap applies to work, not to reuse.
+
+        Pure accounting (no allocation): callers run it after admission
+        and block growth, then build the packed mixed batch from it.
+        """
+        decode = [r for r in self.running if r.prefilled and r.generated]
+        left = budget - len(decode)
+        prefill: list[tuple[Request, int]] = []
+        for r in self.running:
+            if r.prefilled or left <= 0:
+                continue
+            n = min(max_chunk, r.prefill_target - r.prefill_done, left)
+            if n > 0:
+                prefill.append((r, n))
+                left -= n
+        return decode, prefill
+
+    # ------------------------------------------------------------------
     def ensure_decode_blocks(self) -> list[Request]:
         """Grow every running request that needs a block for its next
         token; evict (preempt → requeue) youngest-first on OOM.  A
@@ -286,6 +335,11 @@ class Scheduler:
         req.block_ids = []
         req.n_shared_blocks = 0
         req.pad = 0
+        # unified-tick prefill progress is per-admission state: the
+        # readmission re-prefills prompt+generated from scratch
+        req.prefill_done = 0
+        req.prefill_target = 0
+        req.prefilled = False
         self._release_slot(req)
         self.running.remove(req)
         req.state = RequestState.QUEUED

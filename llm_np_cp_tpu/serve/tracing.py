@@ -58,9 +58,19 @@ from typing import Any, Callable
 # stdlib-only, so it carries its own copy, pinned equal to this one by
 # tests/test_serve_tracing.py.
 REQUEST_PHASES = ("queued", "prefill", "decode")
-# Tick-phase names, in tick order (see ServeEngine.step).
+# Tick-phase names, in tick order (see ServeEngine._step_split).
 TICK_PHASES = (
     "admission", "prefill", "grow", "decode_dispatch", "host_sync",
+    "deliver",
+)
+# Unified-tick phase names (ServeEngine._step_mixed): the separate
+# prefill phase collapses into the single mixed dispatch, and the
+# token-budget planner gets its own slice.  Same consecutive-timestamps
+# sum-to-tick contract; tick args additionally carry the
+# prefill_tokens/decode_tokens budget split for
+# tools/summarize_trace.py's utilization line.
+MIXED_TICK_PHASES = (
+    "admission", "grow", "plan", "mixed_dispatch", "host_sync",
     "deliver",
 )
 

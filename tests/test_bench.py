@@ -84,7 +84,7 @@ def test_priority_matches_config_dicts():
         for n in list(bench.DECODE_CONFIGS) + list(bench.SPEC_CONFIGS)
         + list(bench.PREFILL_CONFIGS) + list(bench.RAGGED_CONFIGS)
         + list(bench.SERVE_CONFIGS) + list(bench.SERVE_HTTP_CONFIGS)
-        + list(bench.SERVE_CHAOS_CONFIGS)
+        + list(bench.SERVE_CHAOS_CONFIGS) + list(bench.SERVE_MIXED_CONFIGS)
         if not n.startswith("smoke")
     }
     assert set(bench.PRIORITY) == non_smoke | bench.EXTRA_CHILDREN
@@ -100,7 +100,8 @@ def test_warm_smoke_offline():
                                  and n not in bench.EXTRA_CHILDREN
                                  and n not in bench.SERVE_CONFIGS
                                  and n not in bench.SERVE_HTTP_CONFIGS
-                                 and n not in bench.SERVE_CHAOS_CONFIGS}
+                                 and n not in bench.SERVE_CHAOS_CONFIGS
+                                 and n not in bench.SERVE_MIXED_CONFIGS}
 
 
 def test_warm_limit_covers_top_priority_only():
@@ -114,7 +115,8 @@ def test_warm_limit_covers_top_priority_only():
                 and n not in bench.RAGGED_CONFIGS
                 and n not in bench.SERVE_CONFIGS
                 and n not in bench.SERVE_HTTP_CONFIGS
-                and n not in bench.SERVE_CHAOS_CONFIGS]
+                and n not in bench.SERVE_CHAOS_CONFIGS
+                and n not in bench.SERVE_MIXED_CONFIGS]
     assert res["warmed"] == warmable[:3]
 
 
@@ -138,6 +140,26 @@ def test_serve_smoke_offline():
     assert res["ttft_s_p50"] > 0
     # jit-stable ticks: ONE decode program regardless of trace length
     assert res["compile_counts"]["decode_step"] == 1
+
+
+def test_serve_mixed_smoke_offline():
+    """The unified-tick child: the same long-prefill-heavy trace through
+    the phase-split and mixed engines — token parity between the legs,
+    at most one dispatch per unified tick (strictly fewer total than
+    phase-split), and one mixed_step compile per packed-width bucket."""
+    res = bench._spawn("smoke_serve_mixed", 600, env={"BENCH_PLATFORM": "cpu"})
+    assert res.get("ok") is True, res
+    assert res["token_parity_mixed_vs_split"] is True
+    assert res["dispatch_win"] is True
+    assert res["dispatches_per_tick"] <= 1.0 < res["dispatches_per_tick_split"]
+    legs = res["legs"]
+    assert legs["mixed"]["mixed_prefill_tokens"] > 0
+    assert legs["mixed"]["mixed_decode_tokens"] > 0
+    assert set(legs["mixed"]["compile_counts"]) == {"mixed_step"}
+    assert (legs["mixed"]["compile_counts"]["mixed_step"]
+            <= len(legs["mixed"]["buckets"]))
+    assert legs["split"]["compile_counts"]["decode_step"] == 1
+    assert res["ragged_kernel_probe"] == "ok"  # interpret mode on CPU
 
 
 @pytest.mark.http

@@ -474,7 +474,10 @@ def test_cli_serve_bench_trace_out_writes_valid_trace(fake_load, capsys,
                 and e["name"] == "finish"]
     assert len(finishes) == 4  # warmup's dummy request is NOT in there
     out = format_summary(events)
-    assert "decode_dispatch" in out
+    # the CLI default is the unified tick (--mixed-step auto, and the
+    # ragged kernel probe passes in CPU interpret mode)
+    assert "mixed_dispatch" in out
+    assert "mixed_step utilization" in out
     # ring-bounded mode caps the buffer
     path2 = tmp_path / "ring_trace.json"
     cli.run([

@@ -47,6 +47,59 @@ def test_softcap_parity():
     np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5)
 
 
+# ---------------------------------------------------------------------------
+# Block-shape validation/padding (the BENCH_TPU_LIVE_r4 fdec warm-log
+# divisibility failure): a partial block_s must satisfy Mosaic's
+# strictest sublane tile among the streamed operands — the 1-byte bool
+# mask needs 32 — or the kernel must pad, never hand Mosaic an
+# unaligned partial block.  Interpret mode hides the rejection, so the
+# regression is pinned on the SELECTION and on padded-path numerics.
+# ---------------------------------------------------------------------------
+
+def test_select_block_s_partial_blocks_are_32_aligned():
+    from llm_np_cp_tpu.ops.pallas.decode_attention import (
+        _BLOCK_S_ALIGN,
+        select_block_s,
+    )
+
+    # the offending class: s with 8-aligned-but-not-32-aligned divisors
+    # only (528 = 16*33; the old selector picked 264 under a small
+    # request/VMEM cap — a bool-mask block Mosaic rejects on hardware)
+    # 8/16 hints were valid pre-32 and must clamp up, not mis-raise on a
+    # perfectly divisible cache with an empty candidate range
+    for s, req in ((528, 264), (384, 512), (200, 64), (4224, 2048),
+                   (264, 64), (1001, 512), (16384, 8), (1024, 16)):
+        got = select_block_s(s, kv_heads=2, head_dim=64, kv_itemsize=2,
+                             requested=req, quantized=False)
+        assert got == s or (got % _BLOCK_S_ALIGN == 0 and s % got == 0), (
+            f"s={s}: block_s={got} is a partial block Mosaic would reject"
+        )
+
+
+def test_decode_attention_pads_unaligned_oversized_cache(monkeypatch):
+    """A cache length with no aligned divisor AND too large for one
+    VMEM block used to raise; now decode_attention pads the cache axis
+    and masks the tail — results must match the XLA reference exactly."""
+    import llm_np_cp_tpu.ops.pallas.decode_attention as da
+
+    # shrink the VMEM budget so s=1000 (8*125, no 32-aligned divisor)
+    # cannot be a single block — forcing the pad path
+    monkeypatch.setattr(da, "_VMEM_BUDGET_BYTES", 64 * 1024)
+    rng = np.random.default_rng(3)
+    b, s, h, kh, d = 2, 1000, 4, 2, 16
+    with pytest.raises(ValueError, match="aligned divisor"):
+        da.select_block_s(s, kh, d, 4, 512, False)
+    q = _rand(rng, (b, 1, h, d))
+    k = _rand(rng, (b, s, kh, d))
+    v = _rand(rng, (b, s, kh, d))
+    mask = jnp.asarray(rng.random((b, s)) > 0.3)
+    mask = mask.at[:, 0].set(True)
+    want = gqa_attention(q, k, v, mask[:, None, :], scale=d**-0.5)
+    got = da.decode_attention(q, k, v, mask, scale=d**-0.5, block_s=512)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=2e-5)
+
+
 def test_block_bounds_cover_exactly_the_visible_blocks():
     """_block_bounds must include every block containing a visible slot
     (correctness) and exclude fully-invisible prefix/suffix blocks (the

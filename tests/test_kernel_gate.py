@@ -87,13 +87,16 @@ def test_odd_request_shapes_match_explicit_capacity(tiny_model):
 
 
 def test_select_block_s_alignment():
-    # aligned capacity: full 8-aligned divisor wins
+    # aligned capacity: full 32-aligned divisor wins (32 = the 1-byte
+    # mask operand's sublane tile, the r4 fdec warm-log fix — 8-aligned
+    # partial blocks compile for the K/V specs and die on the mask spec)
     assert select_block_s(384, 1, 64, 4, 512, False) == 384
     assert select_block_s(1024, 8, 64, 2, 512, False) == 512
     # prime capacity, small enough for one block: whole-s fallback
     assert select_block_s(383, 1, 64, 4, 512, False) == 383
     # prime capacity too large for VMEM: loud failure, not block_s=1
-    with pytest.raises(ValueError, match="multiple of 8"):
+    # (decode_attention catches this and pads the cache axis instead)
+    with pytest.raises(ValueError, match="aligned divisor"):
         select_block_s(100003, 8, 128, 4, 512, False)
 
 

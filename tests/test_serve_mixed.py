@@ -1,0 +1,405 @@
+"""Unified ragged prefill+decode tick (ServeEngine mixed_step).
+
+The acceptance bar for the unified tick is the same output-invisibility
+contract the phase-split engine carries — every request's greedy tokens
+must equal offline ``generate_ragged`` AND the phase-split engine on the
+identical workload (int8 pools, prefix sharing, gemma sliding windows,
+eviction, abort, and chaos-style recovery replays included) — plus the
+two claims that justify the rewrite: ONE device dispatch per tick
+(strictly fewer than phase-split on a long-prefill+decode mix), and one
+``mixed_step`` compile per packed-width bucket with ZERO compiles across
+ticks while the prefill:decode composition churns.
+
+CPU backend; the Pallas ragged kernel runs in interpret mode (same
+kernel logic the TPU compiles), the XLA fallback is exercised via the
+probe-failure hook.
+"""
+
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+sys.path.insert(0, __file__.rsplit("/tests/", 1)[0])
+from llm_np_cp_tpu.config import tiny_config
+from llm_np_cp_tpu.generate import Generator
+from llm_np_cp_tpu.models.transformer import init_params
+from llm_np_cp_tpu.ops.sampling import Sampler
+from llm_np_cp_tpu.serve import ServeEngine, poisson_trace
+from tools.compile_counter import (
+    CompileCounter,
+    assert_serve_compiles_bounded,
+)
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    cfg = tiny_config("llama")
+    params = init_params(jax.random.PRNGKey(0), cfg, dtype=jnp.float32)
+    return cfg, params
+
+
+def _engine(cfg, params, mixed="auto", **kw):
+    kw.setdefault("max_slots", 4)
+    kw.setdefault("num_blocks", 48)
+    kw.setdefault("block_size", 8)
+    kw.setdefault("max_seq_len", 64)
+    kw.setdefault("cache_dtype", jnp.float32)
+    return ServeEngine(params, cfg, sampler=Sampler(kind="greedy"),
+                       mixed_step=mixed, **kw)
+
+
+def _tokens(engine):
+    return {r.req_id: r.generated for r in engine.scheduler.finished}
+
+
+def _assert_offline_parity(engine, cfg, params, cache_dtype):
+    gen = Generator(params, cfg, sampler=Sampler(kind="greedy"),
+                    cache_dtype=cache_dtype)
+    assert engine.scheduler.finished, "nothing finished — bad test setup"
+    for req in engine.scheduler.finished:
+        res = gen.generate_ragged([req.prompt], req.max_new_tokens,
+                                  seed=req.seed)
+        want = [int(t) for t in np.asarray(res.tokens)[0][: req.max_new_tokens]]
+        assert req.generated == want, (
+            f"request {req.req_id} (preempted {req.n_preemptions}x) "
+            "diverged from the offline run"
+        )
+
+
+# ---------------------------------------------------------------------------
+# The acceptance criterion: 32-request offline parity + vs phase-split
+# ---------------------------------------------------------------------------
+
+def test_mixed_trace_parity_32_requests_vs_offline_and_split(tiny):
+    cfg, params = tiny
+    rng = np.random.default_rng(0)
+    trace = poisson_trace(
+        rng, 32, rate_rps=40.0, prompt_len_range=(3, 14),
+        max_new_tokens=6, vocab_size=cfg.vocab_size,
+    )
+
+    def run(mixed):
+        engine = _engine(cfg, params, mixed=mixed)
+        snap = engine.replay_trace(trace)
+        assert snap["finished"] == 32
+        return engine
+
+    mixed = run("auto")
+    assert mixed.mixed and mixed.ragged_attn_impl == "pallas"
+    split = run("off")
+    assert _tokens(mixed) == _tokens(split)
+    _assert_offline_parity(mixed, cfg, params, jnp.float32)
+    assert_serve_compiles_bounded(mixed, distinct_prefill_shapes=0)
+    counts = mixed.compile_counts()
+    assert set(counts) == {"mixed_step"}
+    assert counts["mixed_step"] <= len(mixed.mixed_buckets)
+    # the unified tick's budget accounting is visible in the metrics
+    snap = mixed.metrics.snapshot()
+    assert snap["mixed_decode_tokens"] == snap["total_generated_tokens"] - 32
+    assert snap["mixed_prefill_tokens"] > 0
+
+
+def test_mixed_int8_pool_parity(tiny):
+    cfg, params = tiny
+    rng = np.random.default_rng(11)
+    prompts = [rng.integers(1, cfg.vocab_size, size=n) for n in (6, 11, 4)]
+
+    def run(mixed):
+        engine = _engine(cfg, params, mixed=mixed, max_slots=3,
+                         num_blocks=16, cache_dtype=jnp.int8)
+        for j, p in enumerate(prompts):
+            engine.submit(p, 5, seed=j)
+        engine.run_until_complete()
+        return engine
+
+    mixed = run("auto")
+    assert mixed.mixed and mixed.pool.pages.quantized
+    assert _tokens(mixed) == _tokens(run("off"))
+    _assert_offline_parity(mixed, cfg, params, jnp.int8)
+
+
+def test_mixed_gemma2_sliding_window_parity():
+    """Gemma-2's alternating sliding layers reach the ragged kernel as a
+    traced per-layer window bound — long decodes crossing the window and
+    several block boundaries must match the split engine exactly."""
+    cfg = tiny_config("gemma2")
+    assert cfg.sliding_window is not None
+    params = init_params(jax.random.PRNGKey(2), cfg, dtype=jnp.float32)
+    rng = np.random.default_rng(5)
+    prompts = [rng.integers(1, cfg.vocab_size, size=n) for n in (9, 13)]
+
+    def run(mixed):
+        engine = _engine(cfg, params, mixed=mixed, max_slots=2,
+                         num_blocks=32)
+        for j, p in enumerate(prompts):
+            engine.submit(p, 16, seed=j)
+        engine.run_until_complete()
+        return _tokens(engine)
+
+    assert run("auto") == run("off")
+
+
+def test_mixed_prefix_sharing_parity_and_zero_copy(tiny):
+    """Prefix hits under the unified tick: covered chunks consume no
+    budget and no copy program runs (shared blocks are attended in
+    place) — tokens still match the unshared run and the split engine,
+    and the hit-rate metrics flow."""
+    cfg, params = tiny
+    rng = np.random.default_rng(3)
+    prompts = [rng.integers(1, cfg.vocab_size, size=n) for n in (20, 17)]
+
+    def run(mixed, prefix):
+        engine = _engine(cfg, params, mixed=mixed,
+                         enable_prefix_cache=prefix)
+        for rep in range(4):
+            for j, p in enumerate(prompts):
+                engine.submit(p, 4, seed=j)
+        engine.run_until_complete()
+        return engine
+
+    shared = run("auto", True)
+    assert _tokens(shared) == _tokens(run("auto", False))
+    assert _tokens(shared) == _tokens(run("off", True))
+    snap = shared.metrics.snapshot()
+    assert snap["prefix_blocks_hit"] > 0
+    assert 0 < snap["prefix_hit_rate"] <= 1
+    # covered content consumed no budget: the shared run planned fewer
+    # prefill tokens than the cold run
+    cold = run("auto", False).metrics.snapshot()["mixed_prefill_tokens"]
+    assert snap["mixed_prefill_tokens"] < cold
+    _assert_offline_parity(shared, cfg, params, jnp.float32)
+    fl = shared.pool.free_list
+    assert fl.num_free + fl.num_allocated == fl.capacity
+    assert fl.num_allocated == len(shared.pool.prefix_cache)
+
+
+def test_mixed_eviction_requeue_parity(tiny):
+    cfg, params = tiny
+    rng = np.random.default_rng(7)
+    prompts = [rng.integers(1, cfg.vocab_size, size=n) for n in (4, 5, 3)]
+
+    def run(mixed):
+        engine = _engine(cfg, params, mixed=mixed, max_slots=2,
+                         num_blocks=6)
+        for j, p in enumerate(prompts):
+            engine.submit(p, 20, seed=j)
+        engine.run_until_complete()
+        return engine
+
+    mixed = run("auto")
+    assert mixed.scheduler.n_preemptions > 0, "pool not tight enough"
+    assert _tokens(mixed) == _tokens(run("off"))
+    assert mixed.pool.free_list.num_allocated == 0
+
+
+def test_mixed_abort_mid_prefill_and_mid_decode(tiny):
+    """Abort in every unified-tick state: a request mid-prefill (budget
+    small enough that prefill spans ticks), one mid-decode, one queued —
+    blocks all return, survivors match the split engine."""
+    cfg, params = tiny
+    rng = np.random.default_rng(9)
+    long_p = rng.integers(1, cfg.vocab_size, size=24)
+    short_p = rng.integers(1, cfg.vocab_size, size=5)
+    engine = _engine(cfg, params, max_slots=2, tick_token_budget=10)
+    r_long = engine.submit(long_p, 6, seed=0)
+    r_short = engine.submit(short_p, 6, seed=1)
+    engine.step()
+    assert not r_long.prefilled and r_long.prefill_done > 0, (
+        "budget did not split the long prefill across ticks"
+    )
+    assert engine.abort(r_long.req_id)          # mid-prefill
+    engine.step()
+    assert engine.abort(r_short.req_id) or r_short.finish_reason  # mid-decode
+    r_q = engine.submit(long_p, 4, seed=2)
+    queued_before_abort = r_q.state.value == "queued"
+    assert engine.abort(r_q.req_id)
+    assert queued_before_abort
+    engine.run_until_complete()
+    assert engine.pool.stats()["request_held"] == 0
+    snap = engine.metrics.snapshot()
+    assert snap["finish_reasons"]["aborted"] >= 2
+
+
+def test_mixed_recovery_replay_parity_zero_recompiles(tiny):
+    """The supervisor contract under the unified tick: clone_fresh
+    SHARES the compiled mixed_step, teacher-forced recovery replays are
+    token-identical to an uninterrupted run, and the rebuild+replay
+    compiles NOTHING new."""
+    cfg, params = tiny
+    rng = np.random.default_rng(13)
+    prompts = [rng.integers(1, cfg.vocab_size, size=n) for n in (24, 5, 9)]
+    engine = _engine(cfg, params, max_slots=2, tick_token_budget=10)
+    engine.warmup([int(p.size) for p in prompts], max_new_tokens=8)
+    live = [engine.submit(p, 8, seed=i) for i, p in enumerate(prompts)]
+    for _ in range(3):
+        engine.step()  # some mid-prefill, some mid-decode
+    warm = dict(engine.compile_counts())
+
+    counter = CompileCounter()
+    with counter.watch():
+        rebuilt = engine.clone_fresh()
+        assert rebuilt._mixed_step is engine._mixed_step
+        for r in live:
+            rebuilt.recover(r.prompt, r.max_new_tokens,
+                            request_id=r.req_id, seed=r.seed,
+                            generated=list(r.generated))
+        rebuilt.run_until_complete()
+    assert counter.count == 0, (
+        f"restart + recovery replay compiled: {counter.events}"
+    )
+    assert rebuilt.compile_counts() == warm
+
+    ref = _engine(cfg, params, mixed="off", max_slots=2)
+    for i, p in enumerate(prompts):
+        ref.submit(p, 8, seed=i, request_id=live[i].req_id)
+    ref.run_until_complete()
+    assert _tokens(rebuilt) == _tokens(ref)
+    assert rebuilt.pool.stats()["request_held"] == 0
+
+
+# ---------------------------------------------------------------------------
+# The dispatch win + compile stability (the CPU-measurable acceptance)
+# ---------------------------------------------------------------------------
+
+def test_mixed_strictly_fewer_dispatches_on_long_prefill_mix(tiny):
+    """A long-prefill-heavy trace with decode overlap: the unified tick
+    must issue AT MOST ONE device dispatch per tick — strictly fewer in
+    total than the phase-split engine on the identical workload, whose
+    admission ticks each cost chunks+scatter+sample on top of decode."""
+    cfg, params = tiny
+    rng = np.random.default_rng(1)
+    trace = poisson_trace(
+        rng, 12, rate_rps=30.0, prompt_len_range=(16, 30),
+        max_new_tokens=(2, 8), vocab_size=cfg.vocab_size,
+    )
+
+    def run(mixed):
+        engine = _engine(cfg, params, mixed=mixed, num_blocks=64,
+                         max_seq_len=64)
+        snap = engine.replay_trace(trace)
+        assert snap["finished"] == 12
+        return engine, snap
+
+    mixed, msnap = run("auto")
+    split, ssnap = run("off")
+    assert _tokens(mixed) == _tokens(split)
+    assert mixed.n_dispatches <= msnap["ticks"], (
+        "unified tick issued more than one dispatch per tick"
+    )
+    assert mixed.n_dispatches < split.n_dispatches, (
+        f"no dispatch win: mixed {mixed.n_dispatches} vs split "
+        f"{split.n_dispatches} over {ssnap['ticks']} split ticks"
+    )
+
+
+def test_mixed_zero_compiles_across_ragged_composition_churn(tiny):
+    """After warmup compiles every packed-width bucket, ticks whose
+    prefill:decode row mix churns arbitrarily (fresh prompts, varied
+    lengths and budgets-worth of chunk slices, decode-only tails) must
+    trigger ZERO backend compiles."""
+    cfg, params = tiny
+    engine = _engine(cfg, params)
+    rng = np.random.default_rng(4)
+    lens = (3, 26, 7, 14, 9, 21)
+    engine.warmup([int(n) for n in lens], max_new_tokens=8)
+    warm = dict(engine.compile_counts())
+    assert warm["mixed_step"] == len(engine.mixed_buckets)
+
+    counter = CompileCounter()
+    with counter.watch():
+        for rep in range(3):
+            for i, n in enumerate(lens):
+                engine.submit(rng.integers(1, cfg.vocab_size, size=n),
+                              3 + (i % 5), seed=rep * 10 + i)
+            engine.run_until_complete()
+    assert counter.count == 0, (
+        f"composition churn compiled: {counter.events}"
+    )
+    assert engine.compile_counts() == warm
+
+
+# ---------------------------------------------------------------------------
+# Gating, fallbacks, validation
+# ---------------------------------------------------------------------------
+
+def test_mixed_auto_falls_back_to_split_when_probe_fails(tiny, monkeypatch):
+    import llm_np_cp_tpu.ops.pallas.support as support
+
+    monkeypatch.setattr(support, "_FORCE_FAIL", True)
+    support._probe.cache_clear()
+    try:
+        cfg, params = tiny
+        auto = _engine(cfg, params, mixed="auto")
+        assert not auto.mixed  # conservative: keep the split path
+        forced = _engine(cfg, params, mixed="on")
+        assert forced.mixed and forced.ragged_attn_impl == "xla"
+    finally:
+        support._probe.cache_clear()
+
+
+def test_mixed_xla_fallback_parity(tiny, monkeypatch):
+    """mixed_step='on' with the kernel rejected runs the XLA ragged
+    fallback — still one dispatch per tick, still token-identical."""
+    import llm_np_cp_tpu.ops.pallas.support as support
+
+    cfg, params = tiny
+    rng = np.random.default_rng(21)
+    prompts = [rng.integers(1, cfg.vocab_size, size=n) for n in (14, 5, 9)]
+
+    def run(engine):
+        for j, p in enumerate(prompts):
+            engine.submit(p, 6, seed=j)
+        engine.run_until_complete()
+        return _tokens(engine)
+
+    monkeypatch.setattr(support, "_FORCE_FAIL", True)
+    support._probe.cache_clear()
+    try:
+        xla = _engine(cfg, params, mixed="on")
+        assert xla.ragged_attn_impl == "xla"
+        got = run(xla)
+    finally:
+        support._probe.cache_clear()
+    assert got == run(_engine(cfg, params, mixed="off"))
+    assert xla.n_dispatches <= xla.metrics.snapshot()["ticks"]
+
+
+def test_mixed_runtime_degradation_to_xla_fallback(tiny):
+    """A ragged-kernel dispatch fault mid-traffic degrades to the XLA
+    fallback for the process and retries the same tick (the paged decode
+    step's degradation contract) — requests still finish with the exact
+    split-engine tokens."""
+    from llm_np_cp_tpu.serve import FaultInjector
+    import llm_np_cp_tpu.ops.pallas.support as support
+
+    cfg, params = tiny
+    rng = np.random.default_rng(30)
+    prompts = [rng.integers(1, cfg.vocab_size, size=n) for n in (9, 6)]
+    engine = _engine(cfg, params, fault_injector=FaultInjector("decode@2"))
+    assert engine.ragged_attn_impl == "pallas"
+    try:
+        for j, p in enumerate(prompts):
+            engine.submit(p, 6, seed=j)
+        engine.run_until_complete()
+        assert engine.ragged_attn_impl == "xla"
+        assert engine.decode_degraded is not None
+    finally:
+        # the degradation ledger is process-wide; clean it for the rest
+        # of the suite
+        support._RUNTIME_DISABLED.clear()
+    ref = _engine(cfg, params, mixed="off")
+    for j, p in enumerate(prompts):
+        ref.submit(p, 6, seed=j)
+    ref.run_until_complete()
+    assert _tokens(engine) == _tokens(ref)
+
+
+def test_mixed_rejects_bad_config(tiny):
+    cfg, params = tiny
+    with pytest.raises(ValueError, match="mixed_step"):
+        _engine(cfg, params, mixed="yes")
+    with pytest.raises(ValueError, match="tick_token_budget"):
+        _engine(cfg, params, mixed="on", max_slots=4, tick_token_budget=3)

@@ -188,6 +188,144 @@ def test_single_slot_request_filling_whole_pool_converges():
     assert sched.n_preemptions == 0
 
 
+# ---------------------------------------------------------------------------
+# Token-budget planner (the unified tick's co-schedule, Scheduler.plan_tick)
+# ---------------------------------------------------------------------------
+
+def _simulate_mixed(sched, budget, chunk, shared_done=None,
+                    max_ticks=10_000):
+    """Drive the scheduler exactly like the unified tick (_step_mixed):
+    admit → init prefill progress → grow → plan → apply the plan.
+    Returns per-tick plan records; asserts the planner invariants the
+    engine relies on every tick.  ``shared_done`` maps req_id → content
+    tokens pre-covered by the prefix cache (consume NO budget)."""
+    shared_done = shared_done or {}
+    records = []
+    prefill_budgeted: dict[int, int] = {}
+    for _ in range(max_ticks):
+        for req in sched.admit():
+            req.prefill_target = req.prompt_len + len(req.generated)
+            req.prefill_done = shared_done.get(req.req_id, 0)
+            req.prefilled = False
+        sched.ensure_decode_blocks()
+        decode, prefill = sched.plan_tick(budget, chunk)
+        # -- invariants, every tick --------------------------------------
+        planned = len(decode) + sum(n for _, n in prefill)
+        assert planned <= budget, "budget overrun"
+        assert all(1 <= n <= chunk for _, n in prefill), "chunk cap"
+        # decode rows are NEVER starved: every prefilled running request
+        # with a token to feed is in the decode batch
+        ready = [r for r in sched.running if r.prefilled and r.generated]
+        assert decode == ready
+        # a mid-prefill row always progresses when budget remains
+        waiting = [r for r in sched.running if not r.prefilled]
+        if waiting and budget - len(decode) > 0:
+            assert prefill, "prefill starved despite remaining budget"
+        records.append((len(decode), [(r.req_id, n) for r, n in prefill]))
+        # -- apply the plan (what _step_mixed's deliver phase does) ------
+        for r, n in prefill:
+            prefill_budgeted[r.req_id] = prefill_budgeted.get(r.req_id, 0) + n
+            r.prefill_done += n
+            if r.prefill_done >= r.prefill_target:
+                r.prefilled = True
+                r.generated.append(1)  # first token
+                if r.done:
+                    sched.finish(r)
+        for r in decode:
+            r.generated.append(1)
+            if r.done:
+                sched.finish(r)
+        if not sched.has_work:
+            return records, prefill_budgeted
+    raise AssertionError(f"did not drain in {max_ticks} ticks")
+
+
+def test_planner_budget_exact_and_decode_first():
+    """A long prefill arriving mid-decode must not stall the decoding
+    rows: every tick they decode first, the long prompt fills only the
+    remaining budget, and the total never exceeds it."""
+    sched = _mk(n_blocks=64, slots=3)
+    short = _requests([(4, 30), (4, 30)])
+    for r in short:
+        sched.add(r)
+    long_req = Request(req_id=9, prompt=np.zeros(120, np.int32),
+                       max_new_tokens=2)
+    # bootstrap: prefill the two short requests to decoding state
+    for req in sched.admit():
+        req.prefill_target = req.prompt_len
+        req.prefill_done = 0
+        req.prefilled = False
+    _, prefill = sched.plan_tick(16, 8)
+    for r, n in prefill:
+        r.prefill_done += n
+        if r.prefill_done >= r.prefill_target:
+            r.prefilled = True
+            r.generated.append(1)
+    sched.add(long_req)
+    records, budgeted = _simulate_mixed(sched, budget=16, chunk=8)
+    # while the long prefill ran, both decoders kept decoding every tick
+    long_ticks = [rec for rec in records if any(
+        rid == 9 for rid, _ in rec[1])]
+    assert long_ticks, "long request never prefilled"
+    assert all(rec[0] == 2 for rec in long_ticks[:-1]), (
+        "decode rows starved during the long prefill"
+    )
+    # the long prompt's budgeted tokens exactly cover its content
+    assert budgeted[9] == 120
+    assert sorted(r.req_id for r in sched.finished) == [0, 1, 9]
+
+
+def test_planner_prefix_covered_content_consumes_no_budget():
+    """Prefix-cache-covered content is pre-marked done at admission, so
+    the planner budgets ONLY the uncovered tail (plus the always-
+    re-prefilled final chunk) — a full-coverage twin finishes its
+    prefill in one tick where the cold run needs several."""
+    def run(covered):
+        sched = _mk(n_blocks=64, slots=1)
+        (req,) = _requests([(40, 1)])
+        sched.add(req)
+        _, budgeted = _simulate_mixed(
+            sched, budget=9, chunk=8, shared_done={0: covered})
+        return budgeted[0]
+
+    cold = run(0)
+    warm = run(32)  # 4 chunks covered, final chunk re-prefills
+    assert cold == 40
+    assert warm == 8
+    assert cold - warm == 32  # covered chunks consumed zero budget
+
+
+def test_planner_multiple_prefills_share_budget_oldest_first():
+    """Two queued prompts admitted together split the prefill budget in
+    admission order — the older one finishes first (FIFO preserved), and
+    both make progress when the budget covers more than one chunk."""
+    sched = _mk(n_blocks=64, slots=2)
+    for r in _requests([(24, 2), (24, 2)]):
+        sched.add(r)
+    records, budgeted = _simulate_mixed(sched, budget=12, chunk=8)
+    first_tick = records[0][1]
+    assert [rid for rid, _ in first_tick] == [0, 1]
+    assert first_tick[0][1] == 8  # oldest takes a whole chunk
+    assert first_tick[1][1] == 4  # younger gets the remainder
+    assert budgeted == {0: 24, 1: 24}
+    assert [r.req_id for r in sched.finished] == [0, 1]
+
+
+def test_planner_respects_tiny_budget_progress_guarantee():
+    """budget == max_slots is the liveness floor: even with every other
+    slot decoding, a mid-prefill row advances at least one token per
+    tick (token granularity — no whole-chunk stall), and everything
+    drains."""
+    sched = _mk(n_blocks=64, slots=2)
+    for r in _requests([(4, 20), (30, 3)]):
+        sched.add(r)
+    records, budgeted = _simulate_mixed(sched, budget=2, chunk=8)
+    assert budgeted == {0: 4, 1: 30}
+    assert sorted(r.req_id for r in sched.finished) == [0, 1]
+    # single-token prefill slices appeared (the decode row held 1 slot)
+    assert any(n == 1 for rec in records for _, n in rec[1])
+
+
 def test_no_growth_at_exact_block_boundary():
     """At cache_len == blocks*BLOCK the tick's write slot (cache_len-1)
     still fits the allocation — growing there under pool exhaustion

@@ -377,6 +377,68 @@ def test_summarize_trace_tool(traced_run, tmp_path):
     assert len(load_trace(str(bare))) == len(loaded)
 
 
+def test_mixed_tick_phases_and_summarize_utilization(tiny, tmp_path):
+    """The unified tick's trace contract: every tick emits exactly the
+    MIXED_TICK_PHASES slices at consecutive timestamps (sum-to-tick
+    invariant preserved), tick args carry the prefill/decode token
+    split, and tools/summarize_trace.py renders the mixed_step
+    utilization line from a recorded fixture — budget totals in the
+    summary equal the metrics counters."""
+    from llm_np_cp_tpu.serve.tracing import MIXED_TICK_PHASES
+    from tools.summarize_trace import mixed_utilization
+
+    cfg, params = tiny
+    tracer = TraceRecorder()
+    engine = _engine(cfg, params, tracer=tracer, mixed_step="on",
+                     num_blocks=48)
+    assert engine.mixed
+    rng = np.random.default_rng(3)
+    trace = poisson_trace(rng, 8, rate_rps=50.0, prompt_len_range=(3, 14),
+                          max_new_tokens=5, vocab_size=cfg.vocab_size)
+    snap = engine.replay_trace(trace)
+    assert snap["finished"] == 8
+    events = tracer.events()
+
+    # phase slices: exact vocabulary, consecutive, nested in the tick
+    i, checked = 0, 0
+    while i < len(events):
+        ev = events[i]
+        i += 1
+        if ev.get("cat") != "tick" or ev.get("ph") != "X":
+            continue
+        phases = events[i:i + len(MIXED_TICK_PHASES)]
+        i += len(MIXED_TICK_PHASES)
+        assert [p["name"] for p in phases] == list(MIXED_TICK_PHASES)
+        for p in phases:
+            assert p["ts"] >= ev["ts"] - 1e-6
+            assert p["ts"] + p["dur"] <= ev["ts"] + ev["dur"] + 1e-6
+        if ev["dur"] >= 200.0:
+            cover = sum(p["dur"] for p in phases) / ev["dur"]
+            assert cover >= 0.9
+            checked += 1
+    assert checked > 0
+
+    # the summarize tool's utilization section, off a dumped fixture
+    path = tmp_path / "mixed_trace.json"
+    tracer.dump(str(path))
+    loaded = load_trace(str(path))
+    util = mixed_utilization(loaded)
+    assert util is not None
+    assert util["prefill_tokens"] == snap["mixed_prefill_tokens"] > 0
+    assert util["decode_tokens"] == snap["mixed_decode_tokens"] > 0
+    assert util["decode_tokens"] == snap["total_generated_tokens"] - 8, (
+        "every token after each request's first is a decode-row token"
+    )
+    out = format_summary(loaded, top=3)
+    assert "mixed_step utilization" in out
+    assert "mixed_dispatch" in out
+    totals = phase_totals(loaded)
+    for phase in MIXED_TICK_PHASES:
+        assert phase in totals, f"missing phase {phase}"
+    # a phase-split trace has no utilization section
+    assert mixed_utilization([]) is None
+
+
 # ---------------------------------------------------------------------------
 # Prometheus histograms + phase metrics (the scrape answers
 # "queueing or compute?" without a trace file)

@@ -87,9 +87,41 @@ def assert_serve_compiles_bounded(
     only other program allowed to specialize) — None means "don't
     check".  Anything above these bounds means a step's shapes depend on
     per-tick state — the exact bug this lint exists to catch.
+
+    Unified-tick engines (``engine.mixed``) have ONE program under a
+    stricter contract: ``mixed_step`` compiles at most once per
+    packed-width bucket (``engine.mixed_buckets``) regardless of the
+    prefill:decode row composition, and NONE of the phase-split
+    programs exist — in particular the deleted ``gather_prefix`` copy
+    must not reappear (its job, copying shared prefix K/V into the temp
+    cache, no longer exists: shared blocks are attended in place).
     """
     counts = engine.compile_counts()
     problems = []
+    if getattr(engine, "mixed", False):
+        if set(counts) != {"mixed_step"}:
+            problems.append(
+                f"unified-tick engine reports programs {sorted(counts)}; "
+                "only mixed_step may exist (gather_prefix / "
+                "scatter_prefill / prefill_step are deleted on this path)"
+            )
+        if counts.get("mixed_step", 0) > len(engine.mixed_buckets):
+            problems.append(
+                f"mixed_step compiled {counts['mixed_step']}x for "
+                f"{len(engine.mixed_buckets)} packed-width buckets "
+                "(must be <= one per bucket, never per tick or per "
+                "prefill:decode composition)"
+            )
+        if any(v < 0 for v in counts.values()):
+            problems.append(
+                f"compile counts unavailable on this jax version: {counts}"
+            )
+        if problems:
+            raise AssertionError(
+                "serve/ static-shape lint failed:\n  "
+                + "\n  ".join(problems)
+            )
+        return
     if counts["decode_step"] > 1:
         problems.append(
             f"decode_step compiled {counts['decode_step']}x (must be 1 "
@@ -315,6 +347,38 @@ def _self_check() -> None:
     held = rebuilt.pool.stats()["request_held"]
     assert held == 0, f"recovery replay leaked {held} blocks"
     print(f"compile counts OK (restart+recovery): {rebuilt.compile_counts()}")
+
+    # the unified tick: after warmup compiles every packed-width bucket,
+    # churning the ragged composition (prefill-heavy, decode-only, and
+    # mixed ticks; varied prompt lengths and budgets-worth of chunk
+    # slices) must trigger ZERO further compiles, and the phase-split
+    # programs — the deleted gather_prefix copy above all — must not
+    # exist on this engine at all
+    eng = ServeEngine(
+        params, cfg, sampler=Sampler(kind="greedy"), max_slots=2,
+        num_blocks=32, block_size=8, max_seq_len=64,
+        cache_dtype=jnp.float32, mixed_step="on",
+        enable_prefix_cache=True,
+    )
+    mixed_prompts = [rng.integers(1, 200, size=n) for n in (26, 4, 17, 9)]
+    eng.warmup([int(p.size) for p in mixed_prompts], max_new_tokens=8)
+    warm = dict(eng.compile_counts())
+    assert "gather_prefix" not in warm, (
+        f"deleted gather_prefix program reappeared: {warm}"
+    )
+    with CompileCounter().watch() as counter:
+        for rep in range(3):  # round 2+ hits the prefix cache too
+            for i, p in enumerate(mixed_prompts):
+                eng.submit(p, 3 + i)
+            eng.run_until_complete()
+    assert counter.count == 0, (
+        f"unified-tick composition churn compiled: {counter.events}"
+    )
+    assert eng.compile_counts() == warm
+    assert_serve_compiles_bounded(engine=eng, distinct_prefill_shapes=0)
+    held = eng.pool.stats()["request_held"]
+    assert held == 0, f"unified tick leaked {held} blocks"
+    print(f"compile counts OK (unified tick): {eng.compile_counts()}")
 
     # tracing is host-side only: attaching a recorder mid-life and
     # replaying more traffic must not compile anything new (the step

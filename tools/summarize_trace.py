@@ -80,6 +80,29 @@ def tick_stats(events: list[dict]) -> dict[str, float]:
     }
 
 
+def mixed_utilization(events: list[dict]) -> dict[str, float] | None:
+    """Unified-tick (mixed_step) budget utilization from the per-tick
+    ``prefill_tokens``/``decode_tokens`` args: how the engine's token
+    budget was actually split between catching up prefills and keeping
+    the decode batch fed.  None when no tick carries the args (a
+    phase-split trace)."""
+    ticks = [e.get("args") or {} for e in events
+             if e.get("ph") == "X" and e.get("cat") == "tick"]
+    ticks = [a for a in ticks if "prefill_tokens" in a]
+    if not ticks:
+        return None
+    pre = sum(a["prefill_tokens"] for a in ticks)
+    dec = sum(a["decode_tokens"] for a in ticks)
+    total = pre + dec
+    return {
+        "ticks": len(ticks),
+        "prefill_tokens": pre,
+        "decode_tokens": dec,
+        "tokens_per_tick_mean": total / len(ticks),
+        "prefill_frac": pre / total if total else 0.0,
+    }
+
+
 def slowest_ticks(events: list[dict], k: int) -> list[dict]:
     ticks = [e for e in events
              if e.get("ph") == "X" and e.get("cat") == "tick"]
@@ -132,6 +155,15 @@ def format_summary(events: list[dict], top: int = 5) -> str:
         f"{stats['ticks']} ticks, {stats['tick_total_us'] / 1e3:.2f} ms "
         f"total, phase coverage {stats['phase_coverage']:.1%}"
     )
+    util = mixed_utilization(events)
+    if util is not None:
+        lines.append(
+            f"== mixed_step utilization ==\n"
+            f"{util['prefill_tokens']} prefill + {util['decode_tokens']} "
+            f"decode tokens over {util['ticks']} ticks "
+            f"({util['tokens_per_tick_mean']:.1f} tok/tick, "
+            f"{util['prefill_frac']:.1%} prefill)"
+        )
     lines.append(f"== top {top} slowest ticks ==")
     for ev in slowest_ticks(events, top):
         args = ev.get("args") or {}
