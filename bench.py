@@ -221,6 +221,35 @@ SERVE_MIXED_CONFIGS = {
                               block_size=8),
 }
 
+# Mesh-sharded serving (ServeEngine mesh_plan + serve/replica.py): ONE
+# shared-prompt Poisson trace (the serve_prefix_shared workload shape)
+# replayed over three topologies on identical arrivals — single chip,
+# TP=8 (one engine, kv-head-sharded pool), and DP=4 replicas x TP=2
+# behind the prefix-affinity router.  The observables are the ROADMAP
+# item-1 claims: per-chip tok/s against the 1629 tok/s/chip live
+# capture (BENCH_TPU_LIVE_r4 — wired into the JSON for the next
+# live-TPU window), p99 TTFT per topology, token parity across all
+# legs, and the router's routed/spilled split (shared-prompt traffic
+# must stay block-local).  Legs that need more devices than the
+# backend exposes are skipped with a note, so the config degrades
+# gracefully on a single chip.
+SERVE_SHARDED_CONFIGS = {
+    "serve_sharded_poisson": dict(model="llama1b", requests=32, rate=16.0,
+                                  prompt_len=512, max_tokens=64, slots=8,
+                                  block_size=128, distinct_prompts=8,
+                                  prefix_cache=True, extra_blocks=32,
+                                  tp=8, dp=(4, 2),
+                                  env={"XLA_FLAGS": (
+                                      os.environ.get("XLA_FLAGS", "")
+                                      + " --xla_force_host_platform_"
+                                        "device_count=8").strip()}),
+    "smoke_serve_sharded": dict(model="tiny", requests=8, rate=50.0,
+                                prompt_len=24, max_tokens=6, slots=2,
+                                block_size=8, distinct_prompts=4,
+                                prefix_cache=True, extra_blocks=16,
+                                tp=2, dp=(2, 2)),
+}
+
 SPEC_CONFIGS = {
     # batched self-speculation: bf16 target + int8 self-draft, γ=4
     "int8_spec_bs8": dict(model="llama1b", batch=8, prompt_len=128,
@@ -259,6 +288,7 @@ PRIORITY = [
     "serve_mixed_poisson",  # unified ragged tick vs phase-split head-to-head
     "serve_http_poisson",  # HTTP front-end overhead vs direct engine calls
     "serve_chaos_poisson",  # supervised recovery under a seeded fault schedule
+    "serve_sharded_poisson",  # TP pool sharding + DP replicas vs single chip
     "gemma2_2b_bs8",      # Gemma north-star number (VERDICT task 3)
     "int8_bs8",           # roofline-gap anchor (VERDICT task 6)
     "int8a8_bs8",         # W8A8 int8-MXU einsums vs that anchor
@@ -289,7 +319,7 @@ assert set(PRIORITY) == {
     for n in list(DECODE_CONFIGS) + list(SPEC_CONFIGS)
     + list(PREFILL_CONFIGS) + list(RAGGED_CONFIGS) + list(SERVE_CONFIGS)
     + list(SERVE_HTTP_CONFIGS) + list(SERVE_CHAOS_CONFIGS)
-    + list(SERVE_MIXED_CONFIGS)
+    + list(SERVE_MIXED_CONFIGS) + list(SERVE_SHARDED_CONFIGS)
     if not n.startswith("smoke")
 } | EXTRA_CHILDREN, "PRIORITY out of sync with config dicts"
 
@@ -318,6 +348,10 @@ TIMEOUTS = {
     # restart (backoff + pool rebuild + teacher-forced replay prefills)
     # inside the chaos leg's measured span
     "serve_chaos_poisson": 850,
+    # three trace replays (single / TP / DP x TP) on one param build;
+    # the sharded legs re-place params + pool per topology and the DP
+    # leg warms every replica
+    "serve_sharded_poisson": 850,
     # prefill-dominated: the marginal measurement's extra prefill+half
     # decode per rep nearly doubles measured-phase wall time
     "llama3b_seq2048_bs8": 700,
@@ -969,6 +1003,184 @@ def run_serve_mixed_config(name: str) -> dict:
     }
 
 
+# the per-chip decode rate of the last live hardware capture — the
+# reference every sharded leg's tok_s_per_chip is ratioed against so
+# the next live-TPU window reads scaling efficiency straight off the
+# JSON (CPU runs record the ratio too; it is meaningless there and
+# labeled as such by backend)
+LIVE_REF_TOK_S_PER_CHIP = 1629.0
+LIVE_REF_SOURCE = "BENCH_TPU_LIVE_r4"
+
+
+def run_serve_sharded_config(name: str) -> dict:
+    """Mesh-sharded serving: the SAME shared-prompt Poisson trace over
+    three topologies — single chip, TP=N (one engine, kv-head-sharded
+    paged pool), DP x TP replicas behind the prefix-affinity router —
+    reporting per-chip tok/s (vs the live capture reference), p99 TTFT,
+    token parity across every leg, and the router's routed/spilled
+    verdicts with the fleet prefix hit rate."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from llm_np_cp_tpu.ops.sampling import Sampler
+    from llm_np_cp_tpu.parallel.sharding import MeshPlan
+    from llm_np_cp_tpu.serve import ReplicaSet, ServeEngine, poisson_trace
+    from llm_np_cp_tpu.serve.engine import pool_geometry
+
+    t0 = time.perf_counter()
+    spec = SERVE_SHARDED_CONFIGS[name]
+    config, params = _build_model(spec["model"], tag=name, t0=t0)
+    _phase(name, "params_built", t0)
+
+    bs = spec["block_size"]
+    chunk = min(bs * 2, 256)
+    _, sized_blocks, max_seq_len = pool_geometry(
+        spec["prompt_len"], spec["max_tokens"], spec["slots"], bs,
+        prefill_chunk=chunk,
+    )
+    num_blocks = sized_blocks + spec.get("extra_blocks", 0)
+    n_dev = jax.device_count()
+    tp = spec["tp"]
+    dp_replicas, dp_tp = spec["dp"]
+
+    rng = np.random.default_rng(13)
+    trace = poisson_trace(
+        rng, spec["requests"], rate_rps=spec["rate"],
+        prompt_len_range=(max(spec["prompt_len"] // 4, 1),
+                          spec["prompt_len"]),
+        max_new_tokens=spec["max_tokens"], vocab_size=config.vocab_size,
+        seed_base=13, distinct_prompts=spec.get("distinct_prompts"),
+    )
+    lens = [int(t["prompt"].size) for t in trace]
+    _phase(name, "trace_built", t0)
+
+    def build_engine(plan, devices):
+        return ServeEngine(
+            params, config,
+            sampler=Sampler(kind="greedy"),
+            max_slots=spec["slots"],
+            num_blocks=num_blocks,
+            block_size=bs,
+            max_seq_len=max_seq_len,
+            prefill_chunk=chunk,
+            cache_dtype=jnp.bfloat16,
+            enable_prefix_cache=spec.get("prefix_cache", False),
+            mixed_step="auto",
+            mesh_plan=plan,
+            mesh_devices=devices,
+        )
+
+    legs = {
+        "single": dict(chips=1, replicas=1, tp=1),
+        "tp": dict(chips=tp, replicas=1, tp=tp),
+        "dp_tp": dict(chips=dp_replicas * dp_tp, replicas=dp_replicas,
+                      tp=dp_tp),
+    }
+    per_leg: dict = {}
+    tokens_by_leg: dict = {}
+    for leg, shape in legs.items():
+        if shape["chips"] > n_dev:
+            per_leg[leg] = {
+                "ok": True,
+                "skipped": f"needs {shape['chips']} devices, "
+                           f"have {n_dev}",
+            }
+            continue
+        plan = MeshPlan(model=shape["tp"]) if shape["tp"] > 1 else None
+        devices = jax.devices()
+        per = shape["tp"]
+        engines = [
+            build_engine(
+                plan,
+                devices[i * per:(i + 1) * per] if plan is not None
+                else None,
+            )
+            for i in range(shape["replicas"])
+        ]
+        for e in engines:
+            e.warmup(lens, max_new_tokens=spec["max_tokens"])
+        _phase(name, f"warmed_{leg}", t0, chips=shape["chips"])
+        if shape["replicas"] > 1:
+            fleet = ReplicaSet(engines)
+            snap = fleet.replay_trace(trace)
+            tokens_by_leg[leg] = {
+                r.req_id: list(r.generated) for r in fleet.finished
+            }
+            router = {
+                "router_routed": snap["router_routed"],
+                "router_spilled": snap["router_spilled"],
+            }
+            compile_counts = engines[0].compile_counts()
+        else:
+            snap = engines[0].replay_trace(trace)
+            tokens_by_leg[leg] = {
+                r.req_id: list(r.generated)
+                for r in engines[0].scheduler.finished
+            }
+            router = {}
+            compile_counts = engines[0].compile_counts()
+        _phase(name, f"trace_drained_{leg}", t0, ticks=snap["ticks"])
+        tok_s = snap["throughput_tok_s"]
+        per_leg[leg] = {
+            "ok": snap["finished"] == spec["requests"],
+            "chips": shape["chips"],
+            "mesh": engines[0].mesh_desc,
+            "throughput_tok_s": round(tok_s, 1),
+            "tok_s_per_chip": round(tok_s / shape["chips"], 1),
+            "tok_s_per_chip_vs_live_ref": round(
+                tok_s / shape["chips"] / LIVE_REF_TOK_S_PER_CHIP, 4
+            ),
+            "ttft_s_p50": round(snap.get("ttft_s_p50", float("nan")), 4),
+            "ttft_s_p99": round(snap.get("ttft_s_p99", float("nan")), 4),
+            "prefix_hit_rate": round(snap["prefix_hit_rate"], 3)
+            if "prefix_hit_rate" in snap else None,
+            "ticks": snap["ticks"],
+            "compile_counts": compile_counts,
+            **router,
+        }
+        del engines
+    ran = {k: v for k, v in per_leg.items() if "skipped" not in v}
+    # ordered per-request parity: request ids are assigned in submission
+    # order in every leg (single engine and ReplicaSet both), so keying
+    # by id catches a cross-request stream swap that a multiset compare
+    # would miss — exactly the routing/recovery bug class this config
+    # exists to surface
+    streams = {
+        leg: tuple(
+            tuple(tokens_by_leg[leg][rid])
+            for rid in sorted(tokens_by_leg[leg])
+        )
+        for leg in tokens_by_leg
+    }
+    parity = len(set(streams.values())) <= 1
+    headline = (per_leg.get("dp_tp") if "dp_tp" in ran
+                else per_leg.get("tp") if "tp" in ran
+                else per_leg["single"])
+    return {
+        "config": name,
+        "ok": all(r["ok"] for r in per_leg.values()) and parity
+        and bool(ran),
+        "backend": jax.default_backend(),
+        "devices": n_dev,
+        "requests": spec["requests"],
+        "rate_rps": spec["rate"],
+        "slots": spec["slots"],
+        "pool_blocks": num_blocks,
+        "block_size": bs,
+        "distinct_prompts": spec.get("distinct_prompts"),
+        "token_parity_across_legs": parity,
+        "tok_s_per_chip": headline.get("tok_s_per_chip"),
+        "ttft_s_p99": headline.get("ttft_s_p99"),
+        "live_ref": {
+            "tok_s_per_chip": LIVE_REF_TOK_S_PER_CHIP,
+            "source": LIVE_REF_SOURCE,
+            "comparable": jax.default_backend() == "tpu",
+        },
+        "legs": per_leg,
+    }
+
+
 def _client_pct(vals: list, q: float) -> float:
     """Client-observed-TTFT percentile — the SAME estimator as
     ServeMetrics._pcts (np.percentile linear interpolation), shared by
@@ -1405,7 +1617,7 @@ def run_warm() -> dict:
         if n not in SPEC_CONFIGS and n not in EXTRA_CHILDREN
         and n not in RAGGED_CONFIGS and n not in SERVE_CONFIGS
         and n not in SERVE_HTTP_CONFIGS and n not in SERVE_CHAOS_CONFIGS
-        and n not in SERVE_MIXED_CONFIGS
+        and n not in SERVE_MIXED_CONFIGS and n not in SERVE_SHARDED_CONFIGS
     ]
     for name in warmable[:warm_limit]:
         spec = {**DECODE_CONFIGS, **PREFILL_CONFIGS}[name]
@@ -1750,6 +1962,8 @@ def child_main(mode: str) -> None:
         out = run_serve_http_config(mode)
     elif mode in SERVE_CHAOS_CONFIGS:
         out = run_serve_chaos_config(mode)
+    elif mode in SERVE_SHARDED_CONFIGS:
+        out = run_serve_sharded_config(mode)
     else:
         raise SystemExit(f"unknown config {mode!r}")
     print(json.dumps(out), flush=True)
@@ -2011,7 +2225,7 @@ def main() -> None:
             **DECODE_CONFIGS, **PREFILL_CONFIGS, **SPEC_CONFIGS,
             **RAGGED_CONFIGS, **SERVE_CONFIGS, **SERVE_MIXED_CONFIGS,
             **SERVE_HTTP_CONFIGS,
-            **SERVE_CHAOS_CONFIGS,
+            **SERVE_CHAOS_CONFIGS, **SERVE_SHARDED_CONFIGS,
         }.get(name, {}).get("env")
         res = _spawn(name, budget, env=spec_env)
         detail[name] = res

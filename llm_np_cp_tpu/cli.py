@@ -173,6 +173,23 @@ def _add_serve_engine_flags(p: argparse.ArgumentParser,
                    "the decode batch.  Must be >= --slots; larger = "
                    "faster TTFT, smaller = steadier decode cadence.  "
                    "0 = slots + 2*prefill_chunk")
+    p.add_argument("--mesh", default="", metavar="SPEC",
+                   help="shard EACH engine over a tensor-parallel mesh "
+                   "slice: model=N (parallel/sharding.py syntax; serve "
+                   "meshes are TP-only — params column/row-sharded, pool "
+                   "KV slabs kv-head-partitioned, block tables "
+                   "replicated).  Default: single chip")
+    p.add_argument("--replicas", type=int, default=1, metavar="N",
+                   help="data-parallel engine replicas behind one "
+                   "front-end with prefix-affinity routing "
+                   "(serve/replica.py); composes with --mesh — each "
+                   "replica gets its own mesh slice, so N replicas x "
+                   "TP degree devices are required")
+    p.add_argument("--spill-queue-depth", type=int, default=4, metavar="D",
+                   help="router spill threshold: a request leaves its "
+                   "prefix-affine replica when that replica's queue is "
+                   ">= D deep and a less-loaded replica exists "
+                   "(0 = never spill)")
     p.add_argument("--sampler", choices=["greedy", "min_p", "top_k", "top_p",
                                          "cdf"], default="greedy")
     p.add_argument("--dtype", choices=["bf16", "f32"], default="bf16")
@@ -307,6 +324,58 @@ def _validate_pool_flags(args) -> None:
         )
 
 
+def _resolve_serve_mesh(args, prog: str):
+    """--mesh/--replicas → (MeshPlan | None, replica device slices).
+
+    Validates BEFORE the model load: serve meshes are TP-only, and
+    ``replicas × tp`` devices must exist.  Returns one device slice per
+    replica (None entries = default placement on a single chip)."""
+    import jax
+
+    from llm_np_cp_tpu.parallel.sharding import parse_mesh_spec
+
+    replicas = args.replicas
+    if replicas < 1:
+        raise SystemExit(f"--replicas must be >= 1, got {replicas}")
+    if args.spill_queue_depth < 0:
+        raise SystemExit(
+            f"--spill-queue-depth must be >= 0, got {args.spill_queue_depth}"
+        )
+    plan = None
+    if args.mesh:
+        plan = parse_mesh_spec(args.mesh)
+        for axis in ("data", "seq", "pipe", "expert"):
+            if getattr(plan, axis) != 1:
+                raise SystemExit(
+                    f"--mesh {args.mesh!r}: serve meshes are "
+                    f"tensor-parallel only (model=N); {axis}="
+                    f"{getattr(plan, axis)} is not a serve axis — use "
+                    "--replicas for data parallelism"
+                )
+        if plan.model == 1:
+            plan = None
+    per = plan.num_devices if plan is not None else 1
+    need = per * replicas
+    devices = jax.devices()
+    if plan is not None or replicas > 1:
+        if need > len(devices):
+            raise SystemExit(
+                f"{prog}: --mesh/--replicas need {need} devices "
+                f"({replicas} replicas x {per}), have {len(devices)}"
+            )
+    if plan is None:
+        if replicas == 1:
+            return None, [None]
+        # DP without TP: each replica still gets ITS OWN chip — a
+        # one-device placement mesh (model=1) pins that replica's
+        # params + pool there, so N replicas really occupy N devices
+        # instead of piling onto the default one
+        from llm_np_cp_tpu.parallel.sharding import MeshPlan
+
+        plan = MeshPlan()
+    return plan, [devices[i * per:(i + 1) * per] for i in range(replicas)]
+
+
 def _chaos_injector(args):
     """Resolve --chaos-spec (or LLMTPU_CHAOS_SPEC) into a FaultInjector —
     or None, the zero-overhead default.  Called BEFORE the model load so
@@ -333,7 +402,9 @@ def _chaos_injector(args):
 
 def _build_serve_engine(args, params, config, *, prog: str,
                         tokenizer=None, max_queue: int | None = None,
-                        fault_injector=None):
+                        fault_injector=None, mesh_plan=None,
+                        mesh_devices=None, shared_tracer=None,
+                        quiet=False):
     """The shared engine build for both serve subcommands: validate the
     pool flags, resolve --attn-impl against the Mosaic probe (an EXPLICIT
     paged request must fail with an actionable message when the kernel
@@ -379,9 +450,9 @@ def _build_serve_engine(args, params, config, *, prog: str,
     # device profile only exist while a recorder is attached): the
     # recorder's absence IS the off switch — every engine/HTTP hook is
     # a single is-None check when it is None
-    tracer = None
+    tracer = shared_tracer
     jax_profile = getattr(args, "jax_profile", None)
-    if args.trace_out or args.trace_ring or jax_profile:
+    if tracer is None and (args.trace_out or args.trace_ring or jax_profile):
         from llm_np_cp_tpu.serve.tracing import TraceRecorder
 
         ring = args.trace_ring or None
@@ -422,7 +493,13 @@ def _build_serve_engine(args, params, config, *, prog: str,
         tracer=tracer,
         mixed_step=getattr(args, "mixed_step", "off"),
         tick_token_budget=getattr(args, "tick_token_budget", 0) or None,
+        mesh_plan=mesh_plan,
+        mesh_devices=mesh_devices,
     )
+    if quiet:
+        return engine, num_blocks
+    if engine.mesh is not None:
+        print(f"[{prog}] mesh ACTIVE: {engine.mesh_desc}")
     if engine.mixed:
         print(f"[{prog}] unified tick ACTIVE: one mixed dispatch/tick, "
               f"budget {engine.tick_token_budget} tokens "
@@ -469,11 +546,32 @@ def _run_serve_bench(argv: list[str], default_model: str) -> str:
             f"--distinct-prompts must be >= 0 (0 = every prompt distinct), "
             f"got {args.distinct_prompts}"
         )
+    plan, dev_slices = _resolve_serve_mesh(args, "serve-bench")
     injector = _chaos_injector(args)
     _tok, params, config = _load(args)
     engine, num_blocks = _build_serve_engine(
         args, params, config, prog="serve-bench", fault_injector=injector,
+        mesh_plan=plan, mesh_devices=dev_slices[0],
     )
+    replica_set = None
+    if args.replicas > 1:
+        from llm_np_cp_tpu.serve import ReplicaSet
+
+        peers = [
+            _build_serve_engine(
+                args, params, config, prog="serve-bench",
+                fault_injector=injector, mesh_plan=plan,
+                mesh_devices=dev_slices[i], shared_tracer=engine.tracer,
+                quiet=True,
+            )[0]
+            for i in range(1, args.replicas)
+        ]
+        replica_set = ReplicaSet(
+            [engine] + peers,
+            spill_queue_depth=args.spill_queue_depth or None,
+        )
+        print(f"[serve-bench] replicas ACTIVE: {args.replicas} engines, "
+              "prefix-affinity routing")
     rng = np.random.default_rng(args.seed)
     trace = poisson_trace(
         rng, args.requests, rate_rps=args.rate,
@@ -483,24 +581,51 @@ def _run_serve_bench(argv: list[str], default_model: str) -> str:
         distinct_prompts=args.distinct_prompts or None,
     )
     # compile outside the measured span (steady-state numbers only)
-    engine.warmup([int(t["prompt"].size) for t in trace],
-                  max_new_tokens=args.max_tokens)
+    lens = [int(t["prompt"].size) for t in trace]
+    if replica_set is not None:
+        for e in replica_set.engines:
+            e.warmup(lens, max_new_tokens=args.max_tokens)
+    else:
+        engine.warmup(lens, max_new_tokens=args.max_tokens)
     with _jax_profile_ctx(args):
-        snap = engine.replay_trace(trace, realtime=args.realtime)
+        snap = (replica_set or engine).replay_trace(
+            trace, realtime=args.realtime
+        )
     _dump_trace(engine.tracer, args, "serve-bench")
     tick = (
         f"mixed:{engine.ragged_attn_impl}"
         f"(budget={engine.tick_token_budget})"
         if engine.mixed else "split"
     )
+    topo = engine.mesh_desc or "single chip"
+    if args.replicas > 1:
+        if topo.startswith("pinned to"):
+            # DP without TP: each replica owns one device; replica 0's
+            # own desc would misread as the whole fleet's placement
+            topo = f"{args.replicas} replicas x (1 device each)"
+        else:
+            topo = f"{args.replicas} replicas x ({topo})"
     out = (
         f"[serve-bench] {args.requests} requests @ {args.rate} req/s, "
         f"slots={args.slots}, pool={num_blocks}x{args.block_size} "
         f"({args.cache_dtype}), attn={engine.decode_attn_impl}, "
-        f"tick={tick}, "
+        f"tick={tick}, topo={topo}, "
         f"prefix_cache={'on' if args.prefix_cache else 'off'}\n"
-        + engine.metrics.format()
     )
+    if replica_set is not None:
+        out += (
+            f"fleet: {snap['finished']} finished, "
+            f"{snap['throughput_tok_s']:.1f} tok/s, ttft p99 "
+            f"{snap.get('ttft_s_p99', float('nan')):.3f}s, router "
+            f"{snap['router_routed']} routed / "
+            f"{snap['router_spilled']} spilled\n"
+            + "\n".join(
+                f"-- replica {i} --\n{e.metrics.format()}"
+                for i, e in enumerate(replica_set.engines)
+            )
+        )
+    else:
+        out += engine.metrics.format()
     print(out)
     if args.json:
         print(_json.dumps(snap))
@@ -526,22 +651,54 @@ def _run_http_serve(argv: list[str], default_model: str) -> str:
         raise SystemExit(
             f"--max-restarts must be >= 0, got {args.max_restarts}"
         )
+    plan, dev_slices = _resolve_serve_mesh(args, "serve")
     injector = _chaos_injector(args)
     tok, params, config = _load(args)
     engine, num_blocks = _build_serve_engine(
         args, params, config, prog="serve", tokenizer=tok,
         max_queue=args.max_queue or None, fault_injector=injector,
+        mesh_plan=plan, mesh_devices=dev_slices[0],
     )
+    engines = [engine] + [
+        _build_serve_engine(
+            args, params, config, prog="serve", tokenizer=tok,
+            max_queue=args.max_queue or None, fault_injector=injector,
+            mesh_plan=plan, mesh_devices=dev_slices[i],
+            shared_tracer=engine.tracer, quiet=True,
+        )[0]
+        for i in range(1, args.replicas)
+    ]
+    runner = None
+    if args.replicas > 1:
+        from llm_np_cp_tpu.serve import ReplicaRunner
+
+        runner = ReplicaRunner(
+            engines,
+            request_timeout=args.request_timeout or None,
+            tick_deadline=args.tick_deadline or None,
+            max_restarts=args.max_restarts,
+            restart_window_s=args.restart_window,
+            spill_queue_depth=args.spill_queue_depth or None,
+        )
     # hold the recorder here: a supervised restart rebinds the runner's
     # engine and mutes the dead one's tracer attribute
     tracer = engine.tracer
     # warm the phase programs BEFORE accepting traffic: the first real
     # request must not pay a multi-second model compile in its TTFT
-    engine.warmup([args.prompt_len], max_new_tokens=args.max_tokens)
+    for e in engines:
+        e.warmup([args.prompt_len], max_new_tokens=args.max_tokens)
+    topo = engine.mesh_desc or "single chip"
+    if args.replicas > 1:
+        if topo.startswith("pinned to"):
+            # DP without TP: each replica owns one device; replica 0's
+            # own desc would misread as the whole fleet's placement
+            topo = f"{args.replicas} replicas x (1 device each)"
+        else:
+            topo = f"{args.replicas} replicas x ({topo})"
     banner = (
         f"[serve] model={args.model} slots={args.slots} "
         f"pool={num_blocks}x{args.block_size} ({args.cache_dtype}), "
-        f"attn={engine.decode_attn_impl}, "
+        f"attn={engine.decode_attn_impl}, topo={topo}, "
         f"prefix_cache={'on' if args.prefix_cache else 'off'}, "
         f"max_queue={args.max_queue or 'unbounded'}, "
         f"supervision={'off' if not args.max_restarts else f'{args.max_restarts} restarts'}"
@@ -569,6 +726,7 @@ def _run_http_serve(argv: list[str], default_model: str) -> str:
             port_file=args.port_file,
             exit_after_s=args.exit_after_s,
             on_started=on_started,
+            runner=runner,
         )
     _dump_trace(tracer, args, "serve")
     print("[serve] drained, bye")

@@ -138,6 +138,57 @@ def _kv_heads_shardable(config: ModelConfig, plan: MeshPlan) -> bool:
     return plan.model > 1 and config.num_key_value_heads % plan.model == 0
 
 
+def kv_heads_shardable(config: ModelConfig, plan: MeshPlan) -> bool:
+    """Public twin of the kv-head divisibility rule: True when the KV
+    cache's head axis can be tensor-parallel over "model" (the SURVEY §7
+    "TP + GQA" hard part — Gemma-2's 4 kv heads on an 8-way mesh fall
+    back to replication).  The serve engine keys its Pallas-under-
+    shard_map path on this."""
+    return _kv_heads_shardable(config, plan)
+
+
+def normalize_specs(specs: Any) -> Any:
+    """Strip trailing ``None`` entries from every PartitionSpec leaf.
+
+    ``P(None, None, 'model', None)`` and ``P(None, None, 'model')`` mean
+    the same placement, but GSPMD emits the NORMALIZED spelling on jit
+    outputs while hand-written specs usually carry the trailing None —
+    and jit's compile cache compares shardings by spelling, so an array
+    that round-trips through a step (pool slabs, the serve temp cache)
+    would hit one spurious recompile on its second dispatch.  Serving
+    pins its in-avals through this normalization."""
+
+    def norm(spec: P) -> P:
+        entries = list(spec)
+        while entries and entries[-1] is None:
+            entries.pop()
+        return P(*entries)
+
+    return jax.tree.map(norm, specs, is_leaf=lambda x: isinstance(x, P))
+
+
+def paged_kv_specs(config: ModelConfig, plan: MeshPlan,
+                   quantized: bool = False) -> Any:
+    """PartitionSpecs for the serving block pool's ``PagedKV`` slabs
+    ``[L, NB, BS, K, D]`` — the paged analogue of ``cache_specs``: the
+    kv-head axis shards over "model" when divisible (same rule as the
+    contiguous cache, one decision shared by both layouts), everything
+    else — layer, block, in-block slot — stays unsharded so block
+    tables remain plain replicated scalars and the scalar-prefetch
+    kernels see per-shard-identical indices.  int8 scale pages
+    ``[L, NB, BS, K]`` shard like the values minus D."""
+    from llm_np_cp_tpu.serve.block_pool import PagedKV
+
+    kv = MODEL_AXIS if _kv_heads_shardable(config, plan) else None
+    scale = P(None, None, None, kv) if quantized else None
+    return normalize_specs(PagedKV(
+        k=P(None, None, None, kv, None),
+        v=P(None, None, None, kv, None),
+        k_scale=scale,
+        v_scale=scale,
+    ))
+
+
 def param_specs(config: ModelConfig, plan: MeshPlan) -> dict[str, Any]:
     """PartitionSpec pytree matching models.transformer.param_shapes.
 

@@ -38,6 +38,13 @@ Modules:
   slices as Chrome/Perfetto trace-event JSON (``TraceRecorder``);
   zero-overhead is-None hooks when off, ring-buffered for the
   ``GET /debug/trace`` endpoint, dumped via ``--trace-out``.
+- ``replica``     — mesh-scale-out: ``ReplicaSet``/``ReplicaRunner``
+  run N data-parallel engine replicas (each optionally TP-sharded via
+  ``ServeEngine(mesh_plan=...)`` on its own mesh slice) behind a
+  ``PrefixRouter`` that keys on the prefix cache's chained content
+  hash, so shared-prompt traffic lands on the replica already holding
+  its blocks; spill-to-least-loaded under queue pressure, per-replica
+  abort/drain/supervised recovery.
 - ``http``        — the OpenAI-compatible streaming HTTP front-end
   (``serve`` CLI subcommand): SSE token streams, abort on disconnect or
   deadline, 429 backpressure off the scheduler's queue cap, Prometheus
@@ -53,6 +60,11 @@ from llm_np_cp_tpu.serve.engine import (
 )
 from llm_np_cp_tpu.serve.metrics import ServeMetrics
 from llm_np_cp_tpu.serve.prefix_cache import PrefixCache, prefix_block_keys
+from llm_np_cp_tpu.serve.replica import (
+    PrefixRouter,
+    ReplicaRunner,
+    ReplicaSet,
+)
 from llm_np_cp_tpu.serve.scheduler import (
     QueueFull,
     Request,
@@ -68,7 +80,10 @@ __all__ = [
     "FaultInjector",
     "FreeList",
     "PrefixCache",
+    "PrefixRouter",
     "QueueFull",
+    "ReplicaRunner",
+    "ReplicaSet",
     "Request",
     "RequestState",
     "Scheduler",

@@ -150,6 +150,7 @@ class BlockPool:
         block_size: int,
         dtype: jnp.dtype = jnp.bfloat16,
         enable_prefix_cache: bool = False,
+        shardings: "PagedKV | None" = None,
     ) -> None:
         if block_size < 8 or block_size % 8:
             # Mosaic's second-minor alignment rule for the decode kernels;
@@ -179,6 +180,16 @@ class BlockPool:
             k_scale=jnp.zeros(shape[:-1], jnp.float32) if quantized else None,
             v_scale=jnp.zeros(shape[:-1], jnp.float32) if quantized else None,
         )
+        # mesh-sharded mode: a PagedKV of NamedShardings (kv-head axis on
+        # "model", see parallel/sharding.paged_kv_specs) commits the slabs
+        # onto the mesh; the FREE LIST stays global — allocation is a
+        # host-side decision and every shard holds the same block ids,
+        # only a head-slice of each block's K/V
+        self.shardings = shardings
+        if shardings is not None:
+            import jax
+
+            self.pages = jax.tree.map(jax.device_put, self.pages, shardings)
 
     # -- accounting (delegates; the scheduler talks to these) ----------
     @property
@@ -224,12 +235,44 @@ class BlockPool:
             self.prefix_cache.n_reclaimable
             if self.prefix_cache is not None else 0
         )
-        return {
+        out = {
             "capacity": self.capacity,
             "free": self.free_list.num_free,
             "allocated": allocated,
             "cache_only": cache_only,
             "request_held": allocated - cache_only,
+        }
+        out.update(self.shard_stats())
+        return out
+
+    def shard_stats(self) -> dict[str, int]:
+        """Per-shard KV slab accounting for scrapes and the serve banner.
+
+        ``kv_bytes_shard`` is what ONE device holds (the whole slab when
+        unsharded/replicated; a kv-head slice under TP); ``kv_shards`` is
+        the number of distinct shards the slabs split into (1 when not
+        sharded — replication is not a split).  Occupancy needs no
+        per-shard variant: the free list is global and every shard holds
+        the same block ids, so per-shard occupancy IS ``occupancy`` by
+        construction — that invariant is the whole point of replicated
+        block tables."""
+        import math
+
+        if self.pages is None:  # supervisor yanked the dead engine's slabs
+            return {"kv_bytes_total": 0, "kv_bytes_shard": 0, "kv_shards": 1}
+        arrs = [a for a in self.pages if a is not None]
+        total = sum(a.nbytes for a in arrs)
+        shard = 0
+        for a in arrs:
+            try:
+                shape = a.sharding.shard_shape(a.shape)
+            except (AttributeError, TypeError):
+                shape = a.shape
+            shard += math.prod(shape) * a.dtype.itemsize
+        return {
+            "kv_bytes_total": int(total),
+            "kv_bytes_shard": int(shard),
+            "kv_shards": max(int(round(total / shard)), 1) if shard else 1,
         }
 
     def alloc(self, n: int) -> list[int] | None:

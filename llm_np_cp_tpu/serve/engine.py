@@ -45,6 +45,24 @@ fully-filled leading blocks are looked up in a refcounted registry
 and their prefill chunks are SKIPPED — only the shared K/V is copied
 into the temp prefill cache so the remaining chunks attend correctly.
 
+Mesh-sharded serving (``mesh_plan=MeshPlan(model=N)``): the engine
+builds a ``jax.sharding.Mesh`` over its device slice, tensor-parallels
+the params via ``parallel/sharding.param_specs`` and the pool slabs via
+``paged_kv_specs`` (kv-head-partitioned K/V pages, int8 scale pages
+included), and commits every per-tick operand — block tables above all
+— FULLY REPLICATED, so the scalar-prefetch kernels walk per-shard-
+identical indices over their head-slice of the slabs.  With kv heads
+divisible by the model axis the Pallas ``ragged_paged_attention`` /
+``paged_decode_attention`` kernels run UNMODIFIED inside ``shard_map``
+(``_shard_attn``); otherwise (the TP+GQA hard part) the engine holds
+the partitionable XLA paths.  Step in-avals are pinned — replicated
+operands, ``normalize_specs``-spelled slab/temp-cache shardings,
+``with_sharding_constraint`` on every returned ``PagedKV`` — so each
+program still compiles once per shape bucket and NEVER per tick under
+the mesh.  The engine is TP-only by design; data parallelism is N
+engine replicas behind a prefix-affinity router (serve/replica.py),
+each on its own mesh slice.
+
 Unified tick (``mixed_step="on"/"auto"``): the phase-split pipeline
 above collapses into ONE jit-stable ``mixed_step`` dispatch per tick —
 a packed ragged batch of prefill chunk slices and decode rows runs
@@ -181,6 +199,8 @@ class ServeEngine:
         tracer: TraceRecorder | None = None,
         mixed_step: str = "off",
         tick_token_budget: int | None = None,
+        mesh_plan: Any = None,
+        mesh_devices: list | None = None,
     ) -> None:
         if decode_attn_impl not in ("xla", "flash_decode", "paged"):
             raise ValueError(
@@ -202,6 +222,78 @@ class ServeEngine:
         decode_attn_impl = gate_attn_impl(
             decode_attn_impl, int8_cache=int8_cache
         )
+        # -- mesh-sharded mode (ROADMAP item 1): params tensor-parallel
+        # over "model" via param_specs, pool slabs kv-head-partitioned
+        # via paged_kv_specs, block tables / per-tick operands committed
+        # REPLICATED so every jitted step's in-avals (shardings included)
+        # are identical tick after tick — zero recompiles under the mesh
+        # is the same static-shape contract, extended to placement.  The
+        # engine is TP-only by design: data parallelism is N engine
+        # replicas behind a router (serve/replica.py), each on its own
+        # mesh slice, not a batch axis inside one engine.
+        self.mesh_plan = mesh_plan
+        self._mesh_devices = mesh_devices
+        self.mesh = None
+        self._rep_sharding = None
+        self._pool_shardings = None
+        self._temp_cache_shardings = None
+        self._kv_sharded = False
+        # model=1 with an explicit device slice is the DP-without-TP
+        # placement: a one-device mesh pins this replica's params, pool
+        # and operands onto ITS chip instead of the process default
+        if mesh_plan is not None and (
+            mesh_plan.num_devices > 1 or mesh_devices is not None
+        ):
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            from llm_np_cp_tpu.parallel.sharding import (
+                cache_specs,
+                kv_heads_shardable,
+                make_mesh,
+                normalize_specs,
+                paged_kv_specs,
+                shard_params,
+                to_shardings,
+            )
+
+            for axis in ("data", "seq", "pipe", "expert"):
+                if getattr(mesh_plan, axis) != 1:
+                    raise ValueError(
+                        f"ServeEngine meshes are tensor-parallel only "
+                        f"(model axis); got {axis}={getattr(mesh_plan, axis)}"
+                        " — use serve/replica.py ReplicaSet for data "
+                        "parallelism"
+                    )
+            mesh_plan.validate(config)
+            self.mesh = make_mesh(mesh_plan, mesh_devices)
+            params = shard_params(params, config, mesh_plan, self.mesh)
+            self._rep_sharding = NamedSharding(self.mesh, P())
+            self._kv_sharded = kv_heads_shardable(config, mesh_plan)
+            self._pool_shardings = to_shardings(
+                self.mesh, paged_kv_specs(config, mesh_plan,
+                                          quantized=int8_cache)
+            )
+            self._temp_cache_shardings = to_shardings(
+                self.mesh, normalize_specs(
+                    cache_specs(config, mesh_plan, quantized=int8_cache)
+                )
+            )
+            if mesh_plan.model > 1 and decode_attn_impl == "flash_decode":
+                # the mask-driven decode kernel has no shard_map harness;
+                # under a real TP mesh GSPMD would replicate its custom
+                # call — worse than the partitionable gather math it
+                # wraps (a one-device placement mesh is unaffected)
+                decode_attn_impl = "xla"
+            if (
+                mesh_plan.model > 1
+                and decode_attn_impl == "paged"
+                and not self._kv_sharded
+            ):
+                # kv heads don't divide the model axis (TP + GQA hard
+                # part): the slabs are replicated and the shard_map
+                # harness (which splits the head axes) does not apply —
+                # the partitionable gather path is the honest impl
+                decode_attn_impl = "xla"
         self.decode_attn_impl = decode_attn_impl  # post-gate (tests/CLI)
         # -- unified-tick gate: "on" forces the unified tick (XLA ragged
         # fallback if Mosaic rejects the kernel), "auto" takes it only
@@ -227,6 +319,14 @@ class ServeEngine:
                 self.mixed, self.ragged_attn_impl = True, "xla"
             else:
                 self.mixed = False
+        if (
+            self.mixed and self.mesh is not None
+            and self.mesh_plan.model > 1 and not self._kv_sharded
+        ):
+            # replicated kv heads under real TP: no shard_map harness for
+            # the ragged kernel — the XLA ragged attention partitions
+            # under GSPMD (one-device placement meshes keep the kernel)
+            self.ragged_attn_impl = "xla"
         # seeded chaos schedule (serve/faults.py); None = every injection
         # point is a single is-None check (zero overhead)
         self.faults = fault_injector
@@ -261,6 +361,7 @@ class ServeEngine:
         self.pool = BlockPool(
             config, num_blocks, block_size, dtype=cache_dtype,
             enable_prefix_cache=enable_prefix_cache,
+            shardings=self._pool_shardings,
         )
         self.scheduler = Scheduler(
             self.pool,
@@ -341,6 +442,108 @@ class ServeEngine:
             f"planner produced {n} aligned tokens > largest bucket "
             f"{self.mixed_buckets[-1]} — budget accounting is broken"
         )
+
+    # ------------------------------------------------------------------
+    # Mesh helpers (all no-ops on a single chip)
+    # ------------------------------------------------------------------
+    @property
+    def mesh_desc(self) -> str | None:
+        """Operator-readable mesh topology for the serve banner and
+        ``/healthz`` (None on a single chip)."""
+        if self.mesh is None:
+            return None
+        dev = next(iter(self.mesh.devices.flat))
+        if self.mesh_plan.model == 1:
+            # DP-without-TP placement mesh: one device, nothing sharded
+            return f"pinned to {dev.platform} device {dev.id}"
+        kv = "kv-sharded" if self._kv_sharded else "kv-replicated"
+        return (f"tp={self.mesh_plan.model} over "
+                f"{self.mesh_plan.num_devices} {dev.platform} devices "
+                f"({kv})")
+
+    def _put(self, a: Any) -> jnp.ndarray:
+        """Per-tick operand placement.  Under a mesh every host-built
+        operand (block tables, packed metadata, token ids) is committed
+        FULLY REPLICATED, so each dispatch's in-avals — shardings
+        included — are identical tick after tick: the zero-recompile
+        contract extended to placement.  Replicated tables are also what
+        keeps the scalar-prefetch kernels correct per shard: every
+        device walks the same block ids over its head-slice of the
+        slabs."""
+        if self._rep_sharding is None:
+            return jnp.asarray(a)
+        return jax.device_put(a, self._rep_sharding)
+
+    def _constrain_pages(self, pages: PagedKV) -> PagedKV:
+        """Pin the slabs' sharding on a jitted step's OUTPUT (inside the
+        jaxpr).  The pages a step returns re-enter the next dispatch, so
+        their placement must be a fixed point of the program — GSPMD is
+        free to choose output shardings otherwise, and a drifting choice
+        would retrace every tick."""
+        if self._pool_shardings is None:
+            return pages
+        return jax.tree.map(lax.with_sharding_constraint, pages,
+                            self._pool_shardings)
+
+    def _make_temp_cache(self) -> KVCache:
+        cache = KVCache.init(self.config, 1, self.max_seq_len,
+                             dtype=self.cache_dtype)
+        if self._temp_cache_shardings is not None:
+            cache = jax.tree.map(jax.device_put, cache,
+                                 self._temp_cache_shardings)
+        return cache
+
+    def _repin_temp_cache(self, cache: KVCache) -> KVCache:
+        """Re-commit a chunk-step output cache to the pinned temp-cache
+        shardings (a no-op transfer when GSPMD already kept them): every
+        ``prefill_step`` call must see identical in-avals or its
+        ONE-compile contract breaks on the second chunk."""
+        if self._temp_cache_shardings is None:
+            return cache
+        return jax.tree.map(jax.device_put, cache,
+                            self._temp_cache_shardings)
+
+    def _shard_attn(self, fn: Callable, *, quantized: bool, n_meta: int,
+                    q_head_axis: int) -> Callable:
+        """Wrap a per-layer paged-attention callable for the mesh.
+
+        With kv heads sharded, the Pallas scalar-prefetch kernels (and
+        their XLA fallbacks) run UNMODIFIED inside ``shard_map`` over the
+        model axis: each device sees its head-slice of q
+        (``q_head_axis`` names the head dim) and of the pool slabs
+        (+ int8 scale pages), while tables / lengths / pads / window
+        metadata arrive replicated — GQA's kv-major head order makes the
+        local group math identical to the global one.  Softmax is
+        per-head, so no cross-shard collective is needed; check_rep is
+        off because the kernel's gathers defeat rep inference.
+
+        Off-mesh (or kv-replicated) the callable runs as-is.  Calling
+        convention: ``wrapped(q, k_pages, v_pages, [k_scale, v_scale,]
+        *meta)`` — scales positional only in quantized mode, so None
+        never crosses a shard_map boundary."""
+        if quantized:
+            def call(q, kp, vp, ks, vs, *meta):
+                return fn(q, kp, vp, *meta, k_scale=ks, v_scale=vs)
+        else:
+            def call(q, kp, vp, *meta):
+                return fn(q, kp, vp, *meta, k_scale=None, v_scale=None)
+        if self.mesh is None or not self._kv_sharded:
+            return call
+        from jax.experimental.shard_map import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        from llm_np_cp_tpu.parallel.sharding import MODEL_AXIS
+
+        q_spec = [None, None, None, None][: q_head_axis + 2]
+        q_spec[q_head_axis] = MODEL_AXIS
+        qs = P(*q_spec)
+        kvs = P(None, None, MODEL_AXIS, None)
+        ss = P(None, None, MODEL_AXIS)
+        rep = P()
+        in_specs = (qs, kvs, kvs) + ((ss, ss) if quantized else ())
+        in_specs += (rep,) * n_meta
+        return shard_map(call, mesh=self.mesh, in_specs=in_specs,
+                         out_specs=qs, check_rep=False)
 
     # ------------------------------------------------------------------
     def _prefill_width(self, req: Request) -> int:
@@ -426,6 +629,7 @@ class ServeEngine:
     def _make_scatter_prefill(self) -> Callable:
         quantized = self.cache_dtype == jnp.int8
         bs = self.block_size
+        constrain_pages = self._constrain_pages
 
         @partial(jax.jit, donate_argnums=(0,))
         def scatter_prefill(
@@ -459,7 +663,7 @@ class ServeEngine:
                     if quantized else None
                 ),
             )
-            return new
+            return constrain_pages(new)
 
         return scatter_prefill
 
@@ -515,6 +719,7 @@ class ServeEngine:
         config, sampler = self.config, self.sampler
         bs = self.block_size
         quantized = self.cache_dtype == jnp.int8
+        constrain_pages = self._constrain_pages
 
         @partial(jax.jit, donate_argnums=(1,))
         def decode_step(
@@ -585,7 +790,7 @@ class ServeEngine:
                     if quantized else None
                 ),
             )
-            return nxt, new_pages
+            return nxt, constrain_pages(new_pages)
 
         return decode_step
 
@@ -606,6 +811,15 @@ class ServeEngine:
         quantized = self.cache_dtype == jnp.int8
         win = config.sliding_window
         num_layers = config.num_hidden_layers
+        constrain_pages = self._constrain_pages
+        attn_call = self._shard_attn(
+            partial(
+                paged_decode_attention,
+                scale=config.attn_scale,
+                logit_softcap=config.attn_logit_softcapping,
+            ),
+            quantized=quantized, n_meta=3, q_head_axis=2,
+        )
 
         @partial(jax.jit, donate_argnums=(1,))
         def decode_step(
@@ -675,11 +889,9 @@ class ServeEngine:
                         row_pads = jnp.where(
                             sliding_l, jnp.maximum(pads, vis - win), pads
                         )
-                    return paged_decode_attention(
-                        q, kp2, vp2, tables, vis, row_pads,
-                        k_scale=ksp2, v_scale=vsp2,
-                        scale=config.attn_scale,
-                        logit_softcap=config.attn_logit_softcapping,
+                    scales = (ksp2, vsp2) if quantized else ()
+                    return attn_call(
+                        q, kp2, vp2, *scales, tables, vis, row_pads,
                     )
 
                 x, kv_att, _, _ = run_decoder_layer(
@@ -701,6 +913,7 @@ class ServeEngine:
                 k_scale=ys[2] if quantized else None,
                 v_scale=ys[3] if quantized else None,
             )
+            new_pages = constrain_pages(new_pages)
             logits = final_logits(params, x, config, last_only=True)
             # same (seed, content position) key derivation as the gather
             # step — the RNG stream is impl- and preemption-invariant
@@ -741,6 +954,16 @@ class ServeEngine:
         num_layers = config.num_hidden_layers
         use_kernel = self.ragged_attn_impl == "pallas"
         big_win = jnp.int32(1 << 30)
+        constrain_pages = self._constrain_pages
+        attn_call = self._shard_attn(
+            partial(
+                ragged_paged_attention if use_kernel
+                else ragged_paged_attention_xla,
+                scale=config.attn_scale,
+                logit_softcap=config.attn_logit_softcapping,
+            ),
+            quantized=quantized, n_meta=6, q_head_axis=1,
+        )
 
         @partial(jax.jit, donate_argnums=(1,))
         def mixed_step(
@@ -805,21 +1028,16 @@ class ServeEngine:
                         jnp.where(sliding_l, jnp.int32(win), big_win)
                         if win is not None else big_win
                     )
+                    scales = (ksp2, vsp2) if quantized else ()
                     if use_kernel:
-                        out = ragged_paged_attention(
-                            q[0], kp2, vp2, tables, tile_row,
+                        out = attn_call(
+                            q[0], kp2, vp2, *scales, tables, tile_row,
                             tile_qpos0, tile_qlen, pads, win_eff,
-                            k_scale=ksp2, v_scale=vsp2,
-                            scale=config.attn_scale,
-                            logit_softcap=config.attn_logit_softcapping,
                         )
                     else:
-                        out = ragged_paged_attention_xla(
-                            q[0], kp2, vp2, tables, tok_row, tok_slot,
-                            tok_live, pads, win_eff,
-                            k_scale=ksp2, v_scale=vsp2,
-                            scale=config.attn_scale,
-                            logit_softcap=config.attn_logit_softcapping,
+                        out = attn_call(
+                            q[0], kp2, vp2, *scales, tables, tok_row,
+                            tok_slot, tok_live, pads, win_eff,
                         )
                     return out[None]
 
@@ -842,6 +1060,7 @@ class ServeEngine:
                 k_scale=ys[2] if quantized else None,
                 v_scale=ys[3] if quantized else None,
             )
+            new_pages = constrain_pages(new_pages)
             # logits ONLY at each row's sampled token (decode rows and
             # prefill segments; rows with nothing to sample point at
             # packed index 0 and their draw is discarded host-side)
@@ -1079,6 +1298,8 @@ class ServeEngine:
             tracer=self.tracer,
             mixed_step=self.mixed_step_mode,
             tick_token_budget=self.tick_token_budget or None,
+            mesh_plan=self.mesh_plan,
+            mesh_devices=self._mesh_devices,
         )
         eng.metrics = self.metrics
         eng.decode_degraded = self.decode_degraded
@@ -1219,17 +1440,18 @@ class ServeEngine:
         mask = np.zeros((1, w), dtype=bool)
         ids[0, req.pad:] = content
         mask[0, req.pad:] = True
-        pads = jnp.asarray([req.pad], dtype=jnp.int32)
-        ids_d, mask_d = jnp.asarray(ids), jnp.asarray(mask)
+        pads = self._put(np.asarray([req.pad], dtype=np.int32))
+        ids_d, mask_d = self._put(ids), self._put(mask)
 
-        cache = KVCache.init(self.config, 1, cap, dtype=self.cache_dtype)
+        cache = self._make_temp_cache()
         if n_shared:
             self.n_dispatches += 1
             cache = self._gather_prefix(
                 cache, self.pool.pages,
-                jnp.asarray(np.asarray(req.block_ids[:n_shared], np.int32)),
-                jnp.int32(req.pad),
+                self._put(np.asarray(req.block_ids[:n_shared], np.int32)),
+                self._put(np.int32(req.pad)),
             )
+            cache = self._repin_temp_cache(cache)
         last = None
         for off in range(shared_slots, w, self.prefill_chunk):
             end = off + self.prefill_chunk
@@ -1244,6 +1466,7 @@ class ServeEngine:
                     self.params, ids_d[:, off:end], cache,
                     mask_d[:, off:end], pads,
                 )
+                cache = self._repin_temp_cache(cache)
             if self.tracer is not None and t_chunk >= 0.0:
                 # dispatch time, not device time — async dispatch
                 # returns before the chunk computes; the device side
@@ -1257,8 +1480,8 @@ class ServeEngine:
         self.n_dispatches += 1
         self.pool.pages = self._scatter_prefill(
             self.pool.pages, cache,
-            jnp.asarray(np.asarray(req.block_ids[n_shared:], dtype=np.int32)),
-            jnp.int32(n_shared),
+            self._put(np.asarray(req.block_ids[n_shared:], dtype=np.int32)),
+            self._put(np.int32(n_shared)),
         )
         pc = self.pool.prefix_cache
         keys = req.extra.pop("prefix_keys", None)
@@ -1272,8 +1495,8 @@ class ServeEngine:
         self.n_dispatches += 1
         tok = self._sample_first(
             last,
-            jnp.uint32(req.seed),
-            jnp.int32(content.size - 1),
+            self._put(np.uint32(req.seed)),
+            self._put(np.int32(content.size - 1)),
         )
         self._emit(req, int(np.asarray(tok)[0]))
 
@@ -1360,9 +1583,9 @@ class ServeEngine:
             with (jax.profiler.TraceAnnotation("serve.decode_dispatch")
                   if self.tracer is not None else _NULL_CTX):
                 nxt, self.pool.pages = self._dispatch_decode(
-                    jnp.asarray(tables), jnp.asarray(lengths),
-                    jnp.asarray(pads), jnp.asarray(toks),
-                    jnp.asarray(seeds),
+                    self._put(tables), self._put(lengths),
+                    self._put(pads), self._put(toks),
+                    self._put(seeds),
                 )
             t4 = self.tracer.now_us() if self.tracer is not None else -1.0
             nxt_host = np.asarray(nxt)
@@ -1483,7 +1706,7 @@ class ServeEngine:
                 last_idx[slot] = cur + n - 1
                 sample_pos[slot] = int(sl[-1]) - r.pad
             cur += n_tiles * qb
-        return tuple(jnp.asarray(a) for a in (
+        return tuple(self._put(a) for a in (
             tokens, positions, tok_blk, tok_off, tok_row, tok_slot,
             tok_live, tile_row, tile_qpos0, tile_qlen, tables, pads,
             last_idx, sample_pos, seeds,
@@ -1722,7 +1945,7 @@ class ServeEngine:
         )
         nxt, self.pool.pages = self._mixed_step(
             self.params, self.pool.pages,
-            *(jnp.asarray(a) for a in zeros),
+            *(self._put(a) for a in zeros),
         )
         np.asarray(nxt)  # block until the compile lands
 
@@ -1863,13 +2086,11 @@ class ServeEngine:
             )),
             self.max_blocks_per_seq,
         )
-        cache = KVCache.init(
-            self.config, 1, self.max_seq_len, dtype=self.cache_dtype
-        )
+        cache = self._make_temp_cache()
         for nb in range(1, b_max + 1):
             self.pool.pages = self._scatter_prefill(
-                self.pool.pages, cache, jnp.zeros((nb,), jnp.int32),
-                jnp.int32(0),
+                self.pool.pages, cache, self._put(np.zeros(nb, np.int32)),
+                self._put(np.int32(0)),
             )
         if self.pool.prefix_cache is not None:
             # a prefix hit can cover any share-unit multiple of blocks up
@@ -1882,12 +2103,10 @@ class ServeEngine:
                 // (unit * self.block_size)
             ) * unit
             for h in range(unit, max(h_max, 0) + 1, unit):
-                cache = KVCache.init(
-                    self.config, 1, self.max_seq_len, dtype=self.cache_dtype
-                )
+                cache = self._make_temp_cache()
                 self._gather_prefix(
-                    cache, self.pool.pages, jnp.zeros((h,), jnp.int32),
-                    jnp.int32(0),
+                    cache, self.pool.pages, self._put(np.zeros(h, np.int32)),
+                    self._put(np.int32(0)),
                 )
             self.pool.prefix_cache.clear()
         # the dummy request is not part of any measured trace: drop it
@@ -1915,34 +2134,12 @@ class ServeEngine:
         arrivals are released by a virtual clock that advances to the
         next arrival whenever the engine is idle — the schedule stress
         is preserved without wall-clock sleeps.  realtime=True sleeps
-        until each arrival (live serving simulation).
+        until each arrival (live serving simulation).  The loop itself
+        is serve/trace.replay_arrivals, shared with ReplicaSet.
         """
-        pending = sorted(trace, key=lambda t: t["arrival_s"])
-        t0 = self.clock()
-        virtual_now = 0.0
-        for _ in range(max_ticks):
-            now = self.clock() - t0 if realtime else virtual_now
-            while pending and pending[0]["arrival_s"] <= now:
-                item = pending.pop(0)
-                req = self.submit(
-                    item["prompt"], item["max_new_tokens"],
-                    seed=item.get("seed", 0),
-                    callback=item.get("callback"),
-                    arrival_time=item["arrival_s"],
-                )
-                if realtime:
-                    # wall arrival: TTFT then counts the wait between
-                    # arrival and the tick loop noticing the request
-                    req.extra["arrival_wall"] = t0 + item["arrival_s"]
-            had_work = self.step()
-            if not had_work and pending:
-                nxt = pending[0]["arrival_s"]
-                if realtime:
-                    time.sleep(max(0.0, nxt - (self.clock() - t0)))
-                else:
-                    virtual_now = nxt
-            elif not had_work and not pending:
-                return self.metrics.snapshot()
-            if not realtime:
-                virtual_now = max(virtual_now, self.clock() - t0)
-        raise RuntimeError(f"trace replay did not drain within {max_ticks} ticks")
+        from llm_np_cp_tpu.serve.trace import replay_arrivals
+
+        return replay_arrivals(
+            self, trace, self.metrics.snapshot,
+            realtime=realtime, max_ticks=max_ticks,
+        )

@@ -621,6 +621,7 @@ class HttpServer:
         max_restarts: int = 0,
         restart_backoff_s: float = 0.5,
         restart_window_s: float = 300.0,
+        runner: Any = None,
     ) -> None:
         self.engine = engine
         self.model_id = model_id
@@ -629,7 +630,10 @@ class HttpServer:
         self.drain_timeout = drain_timeout
         self.default_max_tokens = default_max_tokens
         self.max_tokens_cap = max_tokens_cap
-        self.runner = EngineRunner(
+        # ``runner`` injects a prebuilt fleet (serve/replica.ReplicaRunner
+        # — N supervised engine replicas behind prefix-affinity routing);
+        # default is the single-engine runner, exactly as before
+        self.runner = runner if runner is not None else EngineRunner(
             engine, request_timeout=request_timeout,
             tick_deadline=tick_deadline, max_restarts=max_restarts,
             restart_backoff_s=restart_backoff_s,
@@ -758,6 +762,12 @@ class HttpServer:
                 "status": state, "model": self.model_id,
                 "restarts": self.runner.restarts,
             }
+            mesh = getattr(self.runner.engine, "mesh_desc", None)
+            if mesh:
+                payload["mesh"] = mesh
+            replica_states = getattr(self.runner, "replica_states", None)
+            if replica_states is not None:
+                payload["replicas"] = replica_states()
             if crashed:
                 payload["error"] = crashed
             await self._respond(writer, status, json.dumps(payload).encode())
@@ -819,6 +829,13 @@ class HttpServer:
         return method, path, headers, body
 
     def _render_metrics(self) -> str:
+        render = getattr(self.runner, "render_metrics", None)
+        if render is not None:
+            # replica fleet: per-replica series with replica labels +
+            # router counters (serve/replica.ReplicaRunner)
+            return render(extra_gauges={
+                "draining": 1.0 if self.draining else 0.0,
+            })
         # the runner's engine, NOT self.engine: a supervised restart
         # rebinds it, and a scrape must see the live pool/scheduler
         engine = self.runner.engine
@@ -829,6 +846,8 @@ class HttpServer:
             "pool_blocks_free": stats["free"],
             "pool_blocks_request_held": stats["request_held"],
             "pool_blocks_cache_only": stats["cache_only"],
+            "pool_kv_bytes_shard": stats["kv_bytes_shard"],
+            "pool_kv_shards": stats["kv_shards"],
             "inflight_streams": self.runner.inflight,
             "queue_depth_live": engine.scheduler.queue_depth,
             "draining": 1.0 if self.draining else 0.0,
@@ -1082,6 +1101,7 @@ async def run_server(
     port_file: str | None = None,
     exit_after_s: float | None = None,
     on_started: Any = None,
+    runner: Any = None,
 ) -> HttpServer:
     """Start serving and block until drain shutdown completes."""
     server = HttpServer(
@@ -1092,6 +1112,7 @@ async def run_server(
         tick_deadline=tick_deadline, max_restarts=max_restarts,
         restart_backoff_s=restart_backoff_s,
         restart_window_s=restart_window_s,
+        runner=runner,
     )
     await server.start(host, port)
     if port_file:

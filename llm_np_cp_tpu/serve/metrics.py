@@ -295,16 +295,30 @@ class ServeMetrics:
     def prometheus(
         self, extra_gauges: dict[str, float] | None = None,
         prefix: str = "llm_serve",
+        const_labels: dict[str, str] | None = None,
     ) -> str:
         """Text exposition format (0.0.4) for a ``GET /metrics`` scrape.
 
         Rendered from ``snapshot()`` (so a scrape is one locked copy, no
         torn reads).  ``extra_gauges`` lets the HTTP server add live
         gauges the metrics object cannot know (current queue depth, pool
-        free blocks, in-flight streams).
+        free blocks, in-flight streams).  ``const_labels`` are spliced
+        into EVERY sample's labelset — how a multi-replica server tags
+        each engine's series with ``replica="N"`` so counters and
+        histograms aggregate across the fleet.
         """
         s = self.snapshot()
         lines: list[str] = []
+        const = ",".join(
+            f'{k}="{v}"' for k, v in (const_labels or {}).items()
+        )
+
+        def lab(labels: str) -> str:
+            if not const:
+                return labels
+            if not labels:
+                return "{" + const + "}"
+            return labels[:-1] + "," + const + "}"
 
         def emit(name: str, mtype: str, help_: str,
                  samples: list[tuple[str, float]]) -> None:
@@ -312,7 +326,7 @@ class ServeMetrics:
             lines.append(f"# HELP {full} {help_}")
             lines.append(f"# TYPE {full} {mtype}")
             for labels, value in samples:
-                lines.append(f"{full}{labels} {value:.10g}")
+                lines.append(f"{full}{lab(labels)} {value:.10g}")
 
         emit("requests_submitted_total", "counter",
              "Requests accepted into the scheduler queue",
@@ -382,11 +396,13 @@ class ServeMetrics:
             cum = 0
             for le, n in zip(buckets, counts):
                 cum += n
-                lines.append(f'{full}_bucket{{le="{le:.10g}"}} {cum}')
+                labels = lab('{le="%.10g"}' % le)
+                lines.append(f"{full}_bucket{labels} {cum}")
             cum += counts[-1]
-            lines.append(f'{full}_bucket{{le="+Inf"}} {cum}')
-            lines.append(f"{full}_sum {total:.10g}")
-            lines.append(f"{full}_count {cum}")
+            labels = lab('{le="+Inf"}')
+            lines.append(f"{full}_bucket{labels} {cum}")
+            lines.append(f"{full}_sum{lab('')} {total:.10g}")
+            lines.append(f"{full}_count{lab('')} {cum}")
 
         emit_hist("ttft_seconds",
                   "Submit/arrival to first token, per request",

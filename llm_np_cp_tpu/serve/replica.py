@@ -1,0 +1,599 @@
+"""Data-parallel engine replicas behind one front-end, with
+prefix-affinity routing.
+
+Tensor parallelism (``ServeEngine(mesh_plan=...)``) cuts per-token
+latency; CAPACITY scales by running N independent engine+pool stacks —
+each on its own mesh slice — and routing requests between them.  The
+router is where the prefix cache meets the fleet: two requests with the
+same prompt prefix only share KV blocks if they land on the SAME
+replica, so the router keys on the prefix cache's own chained content
+hash (serve/prefix_cache.prefix_block_keys — key equality here IS block
+key equality there) and sticks each prefix chain to one replica.
+Shared-prompt traffic therefore stays block-local by construction;
+unrelated traffic spreads by least-loaded assignment, and queue
+pressure spills a request off its affine replica rather than letting
+affinity amplify a hot spot.
+
+Three layers, smallest first:
+
+- ``PrefixRouter``   — pure routing policy (sticky prefix→replica map,
+  least-loaded assignment, spill-on-pressure, forget-on-death), no
+  engine imports, unit-testable in microseconds.
+- ``ReplicaSet``     — direct-mode fleet for tests and bench: N engines
+  ticked from one loop, ``submit``/``replay_trace`` mirroring the
+  single-engine API, plus ``restart_replica`` (clone_fresh + recover,
+  the supervisor discipline driven synchronously) so one replica's
+  death-and-recovery can be exercised while its peers keep serving.
+- ``ReplicaRunner``  — the HTTP-mode fleet: one ``EngineRunner``
+  (supervised tick thread, serve/http/server.py) per replica behind the
+  runner interface ``HttpServer`` speaks, so abort / drain / supervised
+  restart all stay PER REPLICA — one crashed replica degrades the
+  server, it does not take it down.
+
+Replicas must be geometry-identical (same pool/slots/chunk): the router
+may send any request anywhere, so admission limits cannot differ.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import itertools
+import math
+from typing import Any, Callable
+
+import numpy as np
+
+from llm_np_cp_tpu.serve.prefix_cache import prefix_block_keys
+from llm_np_cp_tpu.serve.scheduler import Request
+
+
+def _ceil_to(n: int, g: int) -> int:
+    return -(-n // g) * g
+
+
+class PrefixRouter:
+    """Sticky prefix-affinity routing over ``n`` replicas.
+
+    ``affinity_key`` mirrors the engine's admission-time hashing exactly
+    (same left-pad, same share-unit truncation, same chained SHA-256),
+    so the deepest shareable block key of a prompt is the routing key —
+    if two prompts route together here, their leading blocks would have
+    matched in a replica's prefix cache, and vice versa.  Prompts too
+    short to share any block fall back to a whole-prompt hash: affinity
+    still groups exact duplicates, it just cannot promise block reuse.
+
+    Policy:
+    - **first sight**: a new key is assigned to the least-loaded alive
+      replica and remembered (``routed`` counts every affinity-honoring
+      verdict, first sights included).
+    - **spill**: when the sticky replica's queue depth is at least
+      ``spill_queue_depth`` AND some other alive replica's is strictly
+      lower, the request goes to the least-loaded replica instead
+      (``spilled``).  The sticky entry is NOT moved — a spill is load
+      shedding, not a migration; the prefix blocks still live where the
+      entry points.
+    - **death**: verdicts never name a dead replica; sticky entries
+      pointing at one are dropped on touch, so its prefixes re-home to
+      live replicas (their blocks died with the pool anyway).
+    """
+
+    def __init__(self, n_replicas: int, *, block_size: int,
+                 prefill_chunk: int,
+                 spill_queue_depth: int | None = 4) -> None:
+        if n_replicas < 1:
+            raise ValueError(f"n_replicas must be >= 1, got {n_replicas}")
+        self.n = n_replicas
+        self.block_size = block_size
+        self.prefill_chunk = prefill_chunk
+        # share granularity in blocks — must mirror ServeEngine._share_unit
+        self._unit = (
+            math.lcm(block_size, prefill_chunk) // block_size
+        )
+        self.spill_queue_depth = spill_queue_depth
+        self._sticky: dict[bytes, int] = {}
+        self._rr = 0  # rotating tiebreak so equal loads spread
+        self.routed = 0
+        self.spilled = 0
+
+    def affinity_chain(
+        self, prompt_ids: Any,
+    ) -> tuple[bytes, tuple[list[bytes], int] | None]:
+        """→ ``(routing key, reusable (keys, prefill_width) or None)``.
+
+        The routing key is the DEEPEST shareable prefix-block key of the
+        prompt — identical to the last entry of the chain the engine
+        registers in its prefix cache — or a whole-prompt hash when no
+        block is shareable.  The chain itself is returned so direct-mode
+        callers can pre-seed ``Request.extra['prefix_keys']`` and the
+        engine's admission plan reuses it instead of re-running the
+        SHA-256 chain over the same prompt."""
+        content = np.asarray(prompt_ids, dtype=np.int32).reshape(-1)
+        w = _ceil_to(max(content.size, 1), self.prefill_chunk)
+        pad = w - content.size
+        n_keys = (
+            (w - self.prefill_chunk) // (self._unit * self.block_size)
+        ) * self._unit
+        if n_keys > 0:
+            keys = prefix_block_keys(content, pad, self.block_size, n_keys)
+            if keys:
+                return keys[-1], (keys, w)
+        return hashlib.sha256(
+            b"whole;" + content.tobytes()
+        ).digest(), None
+
+    def affinity_key(self, prompt_ids: Any) -> bytes:
+        return self.affinity_chain(prompt_ids)[0]
+
+    def _least_loaded(self, loads: list[int], alive: list[bool]) -> int:
+        # ties rotate: an idle fleet's first N distinct prefixes spread
+        # over the N replicas instead of piling onto index 0
+        idx = min(
+            (i for i in range(self.n) if alive[i]),
+            key=lambda i: (loads[i], (i - self._rr) % self.n),
+        )
+        self._rr = (idx + 1) % self.n
+        return idx
+
+    def route(self, key: bytes, *, loads: list[int],
+              queue_depths: list[int] | None = None,
+              alive: list[bool] | None = None) -> tuple[int, bool]:
+        """→ ``(replica index, spilled)``.  ``loads`` orders candidates
+        for least-loaded assignment (live request counts); spill
+        pressure is judged on ``queue_depths`` (defaults to ``loads``) —
+        a deep QUEUE means waiting, a full decode batch is just
+        utilization."""
+        alive = alive if alive is not None else [True] * self.n
+        if not any(alive):
+            raise RuntimeError("no alive replica to route to")
+        qd = queue_depths if queue_depths is not None else loads
+        idx = self._sticky.get(key)
+        if idx is not None and not alive[idx]:
+            del self._sticky[key]  # re-home: the blocks died with the pool
+            idx = None
+        if idx is None:
+            idx = self._least_loaded(loads, alive)
+            self._sticky[key] = idx
+            self.routed += 1
+            return idx, False
+        if (
+            self.spill_queue_depth is not None
+            and qd[idx] >= self.spill_queue_depth
+        ):
+            spill_to = self._least_loaded(loads, alive)
+            if spill_to != idx and qd[spill_to] < qd[idx]:
+                self.spilled += 1
+                return spill_to, True
+        self.routed += 1
+        return idx, False
+
+    def forget_replica(self, idx: int) -> int:
+        """Drop every sticky entry pointing at ``idx`` (replica death /
+        rebuild with a zeroed pool).  Returns how many were dropped."""
+        dead = [k for k, v in self._sticky.items() if v == idx]
+        for k in dead:
+            del self._sticky[k]
+        return len(dead)
+
+
+def _check_homogeneous(engines: list) -> None:
+    if not engines:
+        raise ValueError("need at least one engine")
+    e0 = engines[0]
+    sig0 = (e0.block_size, e0.prefill_chunk, e0.max_seq_len,
+            e0.scheduler.max_slots, e0.pool.num_blocks,
+            str(e0.cache_dtype))
+    for i, e in enumerate(engines[1:], 1):
+        sig = (e.block_size, e.prefill_chunk, e.max_seq_len,
+               e.scheduler.max_slots, e.pool.num_blocks,
+               str(e.cache_dtype))
+        if sig != sig0:
+            raise ValueError(
+                f"replica {i} geometry {sig} != replica 0 {sig0}: the "
+                "router may send any request anywhere, so replicas must "
+                "be geometry-identical"
+            )
+
+
+class ReplicaSet:
+    """Direct-mode data-parallel fleet: N engines, one tick loop.
+
+    The single-engine ``submit``/``step``/``replay_trace`` surface over
+    N replicas — what tests and bench drive (the HTTP path wraps the
+    same engines in ``ReplicaRunner`` instead).  Request ids are
+    globally unique across the set; ``step()`` ticks every alive
+    replica once.
+    """
+
+    def __init__(self, engines: list, *,
+                 spill_queue_depth: int | None = 4) -> None:
+        _check_homogeneous(engines)
+        self.engines = list(engines)
+        e0 = self.engines[0]
+        self.router = PrefixRouter(
+            len(self.engines), block_size=e0.block_size,
+            prefill_chunk=e0.prefill_chunk,
+            spill_queue_depth=spill_queue_depth,
+        )
+        self.alive = [True] * len(self.engines)
+        self._owner: dict[int, int] = {}  # rid → replica index
+        self._next_id = max(e._next_id for e in self.engines)
+        self.clock = e0.clock
+
+    # -- routing-aware single-engine surface ---------------------------
+    def _loads(self) -> list[int]:
+        return [len(e._requests) for e in self.engines]
+
+    def _queue_depths(self) -> list[int]:
+        return [e.scheduler.queue_depth for e in self.engines]
+
+    def submit(self, prompt_ids, max_new_tokens: int, *,
+               seed: int = 0, callback: Callable | None = None,
+               on_event: Callable | None = None,
+               deadline_s: float | None = None,
+               arrival_time: float | None = None,
+               replica: int | None = None) -> Request:
+        """Route (or pin, via ``replica=``) and submit.  The returned
+        Request carries its replica in ``extra['replica']``."""
+        chain = None
+        if replica is None:
+            key, chain = self.router.affinity_chain(prompt_ids)
+            replica, spilled = self.router.route(
+                key, loads=self._loads(),
+                queue_depths=self._queue_depths(), alive=self.alive,
+            )
+        elif not self.alive[replica]:
+            raise RuntimeError(f"replica {replica} is dead")
+        rid = self._next_id
+        self._next_id += 1
+        req = self.engines[replica].submit(
+            prompt_ids, max_new_tokens, request_id=rid, seed=seed,
+            callback=callback, on_event=on_event, deadline_s=deadline_s,
+            arrival_time=arrival_time,
+        )
+        if chain is not None:
+            # hand the router's hash chain to the engine's admission
+            # plan — same content, same width, same chain — so the
+            # prompt is SHA-256'd once per submit, not twice
+            keys, width = chain
+            req.extra["prefix_keys"] = keys
+            req.extra["prefix_keys_width"] = width
+        req.extra["replica"] = replica
+        self._owner[rid] = replica
+        return req
+
+    def abort(self, request_id: int) -> bool:
+        idx = self._owner.get(request_id)
+        if idx is None:
+            return False
+        return self.engines[idx].abort(request_id)
+
+    def step(self) -> bool:
+        """One tick across the fleet; True while any replica has work."""
+        has_work = False
+        for i, engine in enumerate(self.engines):
+            if self.alive[i]:
+                has_work |= engine.step()
+        return has_work
+
+    def run_until_complete(self, max_ticks: int = 100_000) -> None:
+        for _ in range(max_ticks):
+            if not self.step():
+                return
+        raise RuntimeError(
+            f"replica set did not drain within {max_ticks} ticks"
+        )
+
+    @property
+    def finished(self) -> list[Request]:
+        """Terminal requests across the fleet, submission order."""
+        out = [r for e in self.engines for r in e.scheduler.finished]
+        return sorted(out, key=lambda r: r.req_id)
+
+    # -- fleet lifecycle ----------------------------------------------
+    def kill_replica(self, idx: int) -> list[Request]:
+        """Simulate one replica's death: mark it dead (the router stops
+        naming it; its sticky prefixes re-home) and return its in-flight
+        requests — what a supervisor would replay.  The dead engine is
+        left untouched for inspection, exactly like a hung tick thread's
+        engine object."""
+        self.alive[idx] = False
+        self.router.forget_replica(idx)
+        return list(self.engines[idx]._requests.values())
+
+    def restart_replica(self, idx: int) -> None:
+        """Supervised-restart discipline, driven synchronously: rebuild
+        the replica via ``clone_fresh`` (compiled steps shared — a
+        restart never recompiles) and replay its in-flight requests
+        teacher-forced (``recover``), token-identically.  Peers keep
+        serving between ``kill_replica`` and this call — nothing here
+        touches them."""
+        old = self.engines[idx]
+        inflight = sorted(old._requests.values(), key=lambda r: r.req_id)
+        engine = old.clone_fresh()
+        for req in inflight:
+            if len(req.generated) >= req.max_new_tokens:
+                engine.finish_recovered(
+                    req.prompt, req.max_new_tokens, request_id=req.req_id,
+                    generated=req.generated, reason="length",
+                )
+                continue
+            engine.recover(
+                req.prompt, req.max_new_tokens, request_id=req.req_id,
+                seed=req.seed, generated=list(req.generated),
+                callback=req.callback, on_event=req.on_event,
+                deadline_at=req.deadline,
+            )
+        self.engines[idx] = engine
+        self.alive[idx] = True
+
+    # -- aggregate observability ---------------------------------------
+    def snapshot(self) -> dict[str, Any]:
+        """Fleet-level metrics: summed counters, percentile stats over
+        the CONCATENATED per-request samples (a request's TTFT does not
+        care which replica served it), per-replica snapshots, and the
+        router's verdict counters."""
+        per = [e.metrics.snapshot() for e in self.engines]
+        out: dict[str, Any] = {
+            "replicas": per,
+            "n_replicas": len(self.engines),
+            "router_routed": self.router.routed,
+            "router_spilled": self.router.spilled,
+        }
+        for key in ("submitted", "finished", "aborted", "rejected",
+                    "recovered", "ticks", "preemptions",
+                    "total_generated_tokens"):
+            out[key] = sum(s[key] for s in per)
+        span = max((s["wall_s"] for s in per), default=0.0)
+        out["wall_s"] = span
+        out["throughput_tok_s"] = (
+            out["total_generated_tokens"] / span if span > 0 else 0.0
+        )
+        ttft: list[float] = []
+        for e in self.engines:
+            with e.metrics._lock:
+                ttft.extend(e.metrics.ttft_s)
+        if ttft:
+            arr = np.asarray(ttft, dtype=np.float64)
+            for q, name in ((50, "p50"), (90, "p90"), (99, "p99")):
+                out[f"ttft_s_{name}"] = float(np.percentile(arr, q))
+        req = sum(s.get("prefix_blocks_requested", 0) for s in per)
+        hit = sum(s.get("prefix_blocks_hit", 0) for s in per)
+        out["prefix_blocks_requested"] = req
+        out["prefix_blocks_hit"] = hit
+        if req:
+            out["prefix_hit_rate"] = hit / req
+        return out
+
+    # ------------------------------------------------------------------
+    def replay_trace(self, trace: list[dict[str, Any]], *,
+                     realtime: bool = False,
+                     max_ticks: int = 100_000) -> dict[str, Any]:
+        """The single-engine trace replay over the fleet (same loop —
+        serve/trace.replay_arrivals — same virtual-clock discipline),
+        with routing per arrival."""
+        from llm_np_cp_tpu.serve.trace import replay_arrivals
+
+        return replay_arrivals(
+            self, trace, self.snapshot,
+            realtime=realtime, max_ticks=max_ticks,
+        )
+
+
+class ReplicaRunner:
+    """The HTTP-mode fleet: per-replica ``EngineRunner`` supervision
+    behind the one runner interface ``HttpServer`` speaks.
+
+    Every replica keeps its OWN tick thread, watchdog, restart budget,
+    and recovery replay — a crash or hang on one replica degrades the
+    fleet (``state == "degraded"``) while its peers keep streaming; the
+    server only reports ``crashed`` (503) when EVERY replica is
+    terminally dark.  Routing happens at submit time on the event-loop
+    thread: the router reads each runner's live-stream count and each
+    scheduler's queue depth (both plain int reads — racing a tick by one
+    request is harmless for placement).
+    """
+
+    def __init__(self, engines: list, *,
+                 request_timeout: float | None = None,
+                 tick_deadline: float | None = None,
+                 max_restarts: int = 0,
+                 restart_backoff_s: float = 0.5,
+                 restart_window_s: float = 300.0,
+                 spill_queue_depth: int | None = 4) -> None:
+        from llm_np_cp_tpu.serve.http.server import EngineRunner
+
+        _check_homogeneous(engines)
+        self.replicas = [
+            EngineRunner(
+                e, request_timeout=request_timeout,
+                tick_deadline=tick_deadline, max_restarts=max_restarts,
+                restart_backoff_s=restart_backoff_s,
+                restart_window_s=restart_window_s,
+            )
+            for e in engines
+        ]
+        e0 = engines[0]
+        self.router = PrefixRouter(
+            len(engines), block_size=e0.block_size,
+            prefill_chunk=e0.prefill_chunk,
+            spill_queue_depth=spill_queue_depth,
+        )
+        self.faults = self.replicas[0].faults
+        self._owner: dict[int, int] = {}
+        self._rid = itertools.count(
+            max(getattr(e, "_next_id", 0) for e in engines)
+        )
+        self._dead: set[int] = set()  # replicas whose death was forgotten
+
+    # -- the EngineRunner interface ------------------------------------
+    @property
+    def engine(self) -> Any:
+        """A representative engine (tokenizer / tracer / clock access —
+        geometry-identical across the fleet by construction)."""
+        return self.replicas[0].engine
+
+    def start(self) -> None:
+        for r in self.replicas:
+            r.start()
+
+    def stop(self, timeout: float = 10.0) -> None:
+        for r in self.replicas:
+            r.stop(timeout=timeout)
+
+    def next_rid(self) -> int:
+        return next(self._rid)
+
+    @property
+    def inflight(self) -> int:
+        return sum(r.inflight for r in self.replicas)
+
+    @property
+    def restarts(self) -> int:
+        return sum(r.restarts for r in self.replicas)
+
+    @property
+    def recovery_latency_s(self) -> list[float]:
+        return [v for r in self.replicas for v in r.recovery_latency_s]
+
+    @property
+    def crashed(self) -> str | None:
+        """Terminal only when the WHOLE fleet is dark — a single crashed
+        replica is a degradation the router routes around."""
+        downs = [r.crashed for r in self.replicas]
+        if all(downs):
+            return "; ".join(
+                f"replica {i}: {c}" for i, c in enumerate(downs)
+            )
+        return None
+
+    @property
+    def state(self) -> str:
+        if self.crashed:
+            return "crashed"
+        if any(r.crashed or r.recovering for r in self.replicas):
+            return "degraded"
+        return "ok"
+
+    def replica_states(self) -> list[dict[str, Any]]:
+        """Per-replica health for ``/healthz``."""
+        return [
+            {
+                "replica": i,
+                "state": r.state,
+                "restarts": r.restarts,
+                "inflight": r.inflight,
+                "mesh": getattr(r.engine, "mesh_desc", None),
+            }
+            for i, r in enumerate(self.replicas)
+        ]
+
+    def _alive(self) -> list[bool]:
+        alive = [r.crashed is None for r in self.replicas]
+        for i, ok in enumerate(alive):
+            if not ok and i not in self._dead:
+                # first sight of a terminal crash: its sticky prefixes
+                # re-home to survivors
+                self._dead.add(i)
+                self.router.forget_replica(i)
+        return alive
+
+    def submit(self, rid: int, payload: Any, loop: Any, aq: Any) -> None:
+        alive = self._alive()
+        if not any(alive):
+            # mimic EngineRunner's crash answer so handlers need no
+            # fleet-awareness
+            aq.put_nowait(("error",
+                           f"engine tick thread crashed: {self.crashed}"))
+            return
+        key = self.router.affinity_key(payload.prompt_ids)
+        loads = [r.inflight for r in self.replicas]
+        qd = [r.engine.scheduler.queue_depth for r in self.replicas]
+        idx, _spilled = self.router.route(
+            key, loads=loads, queue_depths=qd, alive=alive,
+        )
+        if len(self._owner) > 64 + 4 * max(self.inflight, 1):
+            self._owner = {
+                r: i for r, i in self._owner.items()
+                if r in self.replicas[i]._live
+            }
+        self._owner[rid] = idx
+        self.replicas[idx].submit(rid, payload, loop, aq)
+
+    def abort(self, rid: int) -> None:
+        idx = self._owner.get(rid)
+        if idx is not None:
+            self.replicas[idx].abort(rid)
+        else:
+            for r in self.replicas:
+                r.abort(rid)
+
+    def abort_all(self) -> None:
+        for r in self.replicas:
+            r.abort_all()
+
+    # -- scrape rendering ----------------------------------------------
+    def render_metrics(self, extra_gauges: dict[str, float] | None = None,
+                       ) -> str:
+        """Fleet Prometheus exposition: every per-replica series carries
+        a ``replica`` label (the histograms aggregate across them, which
+        is why they are real histograms), HELP/TYPE headers are emitted
+        once per family, and the router's verdict counters ride at the
+        end."""
+        blocks: list[str] = []
+        seen_meta: set[str] = set()
+        for i, runner in enumerate(self.replicas):
+            engine = runner.engine
+            stats = engine.pool.stats()
+            recov = runner.recovery_latency_s
+            text = engine.metrics.prometheus(
+                extra_gauges={
+                    "pool_blocks_free": stats["free"],
+                    "pool_blocks_request_held": stats["request_held"],
+                    "pool_blocks_cache_only": stats["cache_only"],
+                    "pool_kv_bytes_shard": stats["kv_bytes_shard"],
+                    "pool_kv_shards": stats["kv_shards"],
+                    "inflight_streams": runner.inflight,
+                    "queue_depth_live": engine.scheduler.queue_depth,
+                    "restarts_total": runner.restarts,
+                    "degraded": 1.0 if runner.state != "ok" else 0.0,
+                    "recovery_latency_s_last": recov[-1] if recov else 0.0,
+                    "decode_impl_degraded": (
+                        1.0 if engine.decode_degraded else 0.0
+                    ),
+                },
+                const_labels={"replica": str(i)},
+            )
+            lines = []
+            for line in text.splitlines():
+                if line.startswith("#"):
+                    if line in seen_meta:
+                        continue
+                    seen_meta.add(line)
+                lines.append(line)
+            blocks.append("\n".join(lines))
+        router = (
+            "# HELP llm_serve_router_routed_total Requests routed to "
+            "their prefix-affine replica (first assignments included)\n"
+            "# TYPE llm_serve_router_routed_total counter\n"
+            f"llm_serve_router_routed_total {self.router.routed}\n"
+            "# HELP llm_serve_router_spilled_total Requests spilled off "
+            "their affine replica under queue pressure\n"
+            "# TYPE llm_serve_router_spilled_total counter\n"
+            f"llm_serve_router_spilled_total {self.router.spilled}\n"
+            # fleet-level because the injector is process-global (one
+            # seeded schedule shared by every replica) — the same series
+            # the single-engine scrape exports and the chaos e2e reads
+            "# HELP llm_serve_faults_injected_total Chaos faults "
+            "injected process-wide\n"
+            "# TYPE llm_serve_faults_injected_total gauge\n"
+            "llm_serve_faults_injected_total "
+            f"{self.faults.injected_total if self.faults is not None else 0.0:g}"
+        )
+        for key, value in (extra_gauges or {}).items():
+            router += (
+                f"\n# HELP llm_serve_{key} Live server gauge"
+                f"\n# TYPE llm_serve_{key} gauge"
+                f"\nllm_serve_{key} {float(value):.10g}"
+            )
+        blocks.append(router)
+        return "\n".join(blocks) + "\n"

@@ -15,9 +15,63 @@ tokenizer in CPU tests and bench children.
 
 from __future__ import annotations
 
-from typing import Any
+import time
+from typing import Any, Callable
 
 import numpy as np
+
+
+def replay_arrivals(
+    target: Any,
+    trace: list[dict[str, Any]],
+    snapshot: Callable[[], dict[str, Any]],
+    *,
+    realtime: bool = False,
+    max_ticks: int = 100_000,
+) -> dict[str, Any]:
+    """The ONE arrival-replay loop behind ``ServeEngine.replay_trace``
+    and ``ReplicaSet.replay_trace`` (their hand-rolled twins would
+    diverge on the clock discipline otherwise — and bench compares
+    results across exactly those two paths).
+
+    ``target`` provides ``clock``/``submit``/``step``; ``snapshot``
+    renders the final metrics.  realtime=False (default, what tests and
+    bench use on CPU): arrivals are released by a virtual clock that
+    advances to the next arrival whenever the target is idle — the
+    schedule stress is preserved without wall-clock sleeps.
+    realtime=True sleeps until each arrival (live serving simulation).
+    """
+    pending = sorted(trace, key=lambda t: t["arrival_s"])
+    t0 = target.clock()
+    virtual_now = 0.0
+    for _ in range(max_ticks):
+        now = target.clock() - t0 if realtime else virtual_now
+        while pending and pending[0]["arrival_s"] <= now:
+            item = pending.pop(0)
+            req = target.submit(
+                item["prompt"], item["max_new_tokens"],
+                seed=item.get("seed", 0),
+                callback=item.get("callback"),
+                arrival_time=item["arrival_s"],
+            )
+            if realtime:
+                # wall arrival: TTFT then counts the wait between
+                # arrival and the tick loop noticing the request
+                req.extra["arrival_wall"] = t0 + item["arrival_s"]
+        had_work = target.step()
+        if not had_work and pending:
+            nxt = pending[0]["arrival_s"]
+            if realtime:
+                time.sleep(max(0.0, nxt - (target.clock() - t0)))
+            else:
+                virtual_now = nxt
+        elif not had_work and not pending:
+            return snapshot()
+        if not realtime:
+            virtual_now = max(virtual_now, target.clock() - t0)
+    raise RuntimeError(
+        f"trace replay did not drain within {max_ticks} ticks"
+    )
 
 
 def poisson_trace(

@@ -42,6 +42,11 @@ def pytest_configure(config):
         "chaos: fault-injection / supervised-recovery tests "
         "(serve/faults.py) — deterministic seeded schedules, in tier-1",
     )
+    config.addinivalue_line(
+        "markers",
+        "mesh: multi-chip serve tests on the 8-device virtual CPU mesh "
+        "(ServeEngine mesh_plan / serve/replica.py) — in tier-1",
+    )
 
 
 @pytest.fixture

@@ -85,6 +85,7 @@ def test_priority_matches_config_dicts():
         + list(bench.PREFILL_CONFIGS) + list(bench.RAGGED_CONFIGS)
         + list(bench.SERVE_CONFIGS) + list(bench.SERVE_HTTP_CONFIGS)
         + list(bench.SERVE_CHAOS_CONFIGS) + list(bench.SERVE_MIXED_CONFIGS)
+        + list(bench.SERVE_SHARDED_CONFIGS)
         if not n.startswith("smoke")
     }
     assert set(bench.PRIORITY) == non_smoke | bench.EXTRA_CHILDREN
@@ -101,7 +102,8 @@ def test_warm_smoke_offline():
                                  and n not in bench.SERVE_CONFIGS
                                  and n not in bench.SERVE_HTTP_CONFIGS
                                  and n not in bench.SERVE_CHAOS_CONFIGS
-                                 and n not in bench.SERVE_MIXED_CONFIGS}
+                                 and n not in bench.SERVE_MIXED_CONFIGS
+                                 and n not in bench.SERVE_SHARDED_CONFIGS}
 
 
 def test_warm_limit_covers_top_priority_only():
@@ -160,6 +162,30 @@ def test_serve_mixed_smoke_offline():
             <= len(legs["mixed"]["buckets"]))
     assert legs["split"]["compile_counts"]["decode_step"] == 1
     assert res["ragged_kernel_probe"] == "ok"  # interpret mode on CPU
+
+
+def test_serve_sharded_smoke_offline():
+    """The mesh-sharded serving child: one shared-prompt trace over
+    single-chip / TP=2 / DP=2xTP=2 legs on the 8-virtual-device CPU
+    backend — token parity across every topology, routed shared-prompt
+    traffic with zero spills, and the live per-chip reference wired
+    into the JSON for the next hardware window."""
+    res = bench._spawn("smoke_serve_sharded", 600, env={
+        "BENCH_PLATFORM": "cpu",
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
+    })
+    assert res.get("ok") is True, res
+    assert res["token_parity_across_legs"] is True
+    legs = res["legs"]
+    assert "skipped" not in legs["tp"] and "skipped" not in legs["dp_tp"]
+    assert "kv-sharded" in legs["tp"]["mesh"]
+    assert legs["dp_tp"]["router_spilled"] == 0
+    assert legs["dp_tp"]["router_routed"] == res["requests"]
+    for leg in legs.values():
+        assert leg["tok_s_per_chip"] > 0
+        assert leg["prefix_hit_rate"] > 0
+    assert res["live_ref"]["tok_s_per_chip"] == 1629.0
+    assert res["live_ref"]["comparable"] is False  # CPU child
 
 
 @pytest.mark.http

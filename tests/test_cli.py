@@ -449,6 +449,37 @@ def test_cli_serve_bench_paged_and_prefix_cache(fake_load, capsys):
     assert float(m.group(1)) > 0, out
 
 
+def test_cli_serve_bench_mesh_and_replicas(fake_load, capsys):
+    """--mesh model=2 --replicas 2 replays the trace through a
+    TP-sharded ReplicaSet on the 8-device CPU backend: the banner names
+    the topology and the fleet line reports the router's verdicts."""
+    out = cli.run([
+        "serve-bench", "--requests=6", "--rate=50", "--prompt-len=24",
+        "--max-tokens=3", "--slots=2", "--block-size=8", "--seed=1",
+        "--mesh", "model=2", "--replicas=2", "--prefix-cache",
+    ])
+    printed = capsys.readouterr().out
+    assert "mesh ACTIVE: tp=2" in printed
+    assert "replicas ACTIVE: 2 engines" in printed
+    assert "topo=2 replicas x (tp=2" in out
+    assert "routed" in out and "spilled" in out
+    assert "-- replica 1 --" in out
+
+
+def test_cli_serve_mesh_validation(fake_load):
+    """Mesh/replica flag errors fire BEFORE the model load: non-TP
+    axes, bad replica counts, and device overcommit are all
+    SystemExit with actionable messages."""
+    base = ["serve-bench", "--requests=2", "--prompt-len=8",
+            "--max-tokens=2", "--slots=2", "--block-size=8"]
+    with pytest.raises(SystemExit, match="tensor-parallel only"):
+        cli.run(base + ["--mesh", "data=2"])
+    with pytest.raises(SystemExit, match="--replicas"):
+        cli.run(base + ["--replicas=0"])
+    with pytest.raises(SystemExit, match="devices"):
+        cli.run(base + ["--mesh", "model=8", "--replicas=4"])
+
+
 def test_cli_serve_bench_trace_out_writes_valid_trace(fake_load, capsys,
                                                       tmp_path):
     """--trace-out: the replay records request spans + tick phases and

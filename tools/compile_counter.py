@@ -254,11 +254,22 @@ def _self_check() -> None:
     import sys
 
     sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    # the mesh section below needs virtual devices; the flag must land
+    # before the CPU backend initializes (conftest discipline — jax may
+    # already be imported, but no computation has run yet)
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=8"
+    ).strip()
     import jax
     import jax.numpy as jnp
     import numpy as np
 
     jax.config.update("jax_platforms", "cpu")
+    try:
+        jax.config.update("jax_num_cpu_devices", 8)
+    except AttributeError:
+        pass  # older jax reads the XLA_FLAGS knob set above
     from llm_np_cp_tpu.config import tiny_config
     from llm_np_cp_tpu.models.transformer import init_params
     from llm_np_cp_tpu.ops.sampling import Sampler
@@ -379,6 +390,78 @@ def _self_check() -> None:
     held = eng.pool.stats()["request_held"]
     assert held == 0, f"unified tick leaked {held} blocks"
     print(f"compile counts OK (unified tick): {eng.compile_counts()}")
+
+    # the MESH-sharded engine (ServeEngine mesh_plan): the static-shape
+    # contract extends to placement — params TP-sharded, pool slabs
+    # kv-head-partitioned, per-tick operands committed replicated — so
+    # ticks must trigger ZERO compiles under the mesh once the buckets
+    # are warm, whatever the composition, and a replica restart via
+    # clone_fresh must SHARE the compiled sharded steps (restart never
+    # recompiles, even across a mesh)
+    if jax.device_count() >= 2:
+        from llm_np_cp_tpu.parallel.sharding import MeshPlan
+
+        mesh_cfg = tiny_config(
+            "llama", num_attention_heads=8, num_key_value_heads=4,
+            head_dim=8, hidden_size=64,
+        )
+        mesh_params = init_params(
+            jax.random.PRNGKey(7), mesh_cfg, dtype=jnp.float32
+        )
+        eng = ServeEngine(
+            mesh_params, mesh_cfg, sampler=Sampler(kind="greedy"),
+            max_slots=2, num_blocks=32, block_size=8, max_seq_len=64,
+            cache_dtype=jnp.float32, mixed_step="on",
+            enable_prefix_cache=True, mesh_plan=MeshPlan(model=2),
+        )
+        mesh_prompts = [rng.integers(1, 200, size=n) for n in (26, 4, 17)]
+        eng.warmup([int(p.size) for p in mesh_prompts], max_new_tokens=8)
+        warm = dict(eng.compile_counts())
+        with CompileCounter().watch() as counter:
+            for rep in range(2):  # round 2 hits the prefix cache
+                for i, p in enumerate(mesh_prompts):
+                    eng.submit(p, 3 + i)
+                eng.run_until_complete()
+        assert counter.count == 0, (
+            f"sharded unified-tick churn compiled: {counter.events}"
+        )
+        assert_serve_compiles_bounded(engine=eng, distinct_prefill_shapes=0)
+        # replica restart: clone_fresh + teacher-forced recovery on the
+        # SAME mesh slice must not compile anything
+        live = [eng.submit(p, 6) for p in mesh_prompts]
+        for _ in range(2):
+            eng.step()
+        rebuilt_mesh = eng.clone_fresh()
+        with CompileCounter().watch() as counter:
+            for r in live:
+                rebuilt_mesh.recover(
+                    r.prompt, r.max_new_tokens, request_id=r.req_id,
+                    seed=r.seed, generated=list(r.generated),
+                )
+            rebuilt_mesh.run_until_complete()
+        assert counter.count == 0, (
+            f"sharded replica restart recompiled: {counter.events}"
+        )
+        assert rebuilt_mesh.compile_counts() == warm
+        held = rebuilt_mesh.pool.stats()["request_held"]
+        assert held == 0, f"sharded restart leaked {held} blocks"
+        # the sharded phase-split engine obeys the same bounds
+        eng = ServeEngine(
+            mesh_params, mesh_cfg, sampler=Sampler(kind="greedy"),
+            max_slots=2, num_blocks=32, block_size=8, max_seq_len=64,
+            cache_dtype=jnp.float32, mesh_plan=MeshPlan(model=2),
+        )
+        for p in mesh_prompts:
+            eng.submit(p, 6)
+        eng.run_until_complete()
+        shapes = {-(-(-(-int(p.size) // 8) * 8) // 8) for p in mesh_prompts}
+        assert_serve_compiles_bounded(
+            engine=eng, distinct_prefill_shapes=len(shapes),
+        )
+        print(f"compile counts OK (mesh tp=2): {warm} / "
+              f"{eng.compile_counts()}")
+    else:
+        print("compile counts: mesh section SKIPPED (1 device)")
 
     # tracing is host-side only: attaching a recorder mid-life and
     # replaying more traffic must not compile anything new (the step
