@@ -534,10 +534,11 @@ class ServeEngine:
 
         from llm_np_cp_tpu.parallel.sharding import MODEL_AXIS
 
-        q_spec = [None, None, None, None][: q_head_axis + 2]
-        q_spec[q_head_axis] = MODEL_AXIS
-        qs = P(*q_spec)
-        kvs = P(None, None, MODEL_AXIS, None)
+        # no trailing Nones anywhere: unspecified trailing dims are
+        # unsharded, and the normalized spelling is the one jit's
+        # compile cache expects (tools/lint R1)
+        qs = P(*([None] * q_head_axis), MODEL_AXIS)
+        kvs = P(None, None, MODEL_AXIS)
         ss = P(None, None, MODEL_AXIS)
         rep = P()
         in_specs = (qs, kvs, kvs) + ((ss, ss) if quantized else ())
@@ -1498,6 +1499,9 @@ class ServeEngine:
             self._put(np.uint32(req.seed)),
             self._put(np.int32(content.size - 1)),
         )
+        # lint: disable=R2 -- the phase-split design emits the first
+        # token inside the prefill phase (its wall time is accounted to
+        # prefill_s); the unified tick retired this extra sync
         self._emit(req, int(np.asarray(tok)[0]))
 
     # ------------------------------------------------------------------

@@ -28,25 +28,9 @@ except AttributeError:
     ).strip()
 
 
-def pytest_configure(config):
-    config.addinivalue_line(
-        "markers", "slow: excluded from the tier-1 run (-m 'not slow')",
-    )
-    config.addinivalue_line(
-        "markers",
-        "http: serve/http tests — they bind 127.0.0.1:0 (ephemeral "
-        "loopback ports only), so tier-1 stays hermetic",
-    )
-    config.addinivalue_line(
-        "markers",
-        "chaos: fault-injection / supervised-recovery tests "
-        "(serve/faults.py) — deterministic seeded schedules, in tier-1",
-    )
-    config.addinivalue_line(
-        "markers",
-        "mesh: multi-chip serve tests on the 8-device virtual CPU mesh "
-        "(ServeEngine mesh_plan / serve/replica.py) — in tier-1",
-    )
+# Markers (slow/http/chaos/mesh) are registered centrally in the
+# repo-root pytest.ini so every invocation — including ones that bypass
+# this conftest — knows them.
 
 
 @pytest.fixture

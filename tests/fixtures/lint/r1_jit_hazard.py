@@ -1,0 +1,44 @@
+"""R1 bite fixture: every jit-hazard class in one known-bad module.
+
+Parsed by tests/test_lint.py, never imported or executed.  Lines
+carrying an expected finding end with a BITE marker comment.
+"""
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+LINT_PSPEC_CONSUMER = True  # opt this fixture into the serve-scope check
+
+
+@jax.jit
+def bad_branch(x):
+    if x > 0:  # BITE traced if
+        return x
+    while x.sum() < 1:  # BITE traced while
+        x = x + 1
+    return -x
+
+
+@partial(jax.jit, static_argnames=("mode",))
+def bad_debug(x, mode):
+    if mode == "fast":  # static arg: NOT a finding
+        x = x * 2
+    if x.shape[0] > 1:  # static .shape escape: NOT a finding
+        x = x[:1]
+    print("tracing", x)  # BITE print in traced code
+    label = f"x={x}"  # BITE f-string in traced code
+    y = x if x.sum() > 0 else -x  # BITE traced ternary
+    if label is None:  # is-None identity: NOT a finding
+        raise ValueError(f"bad {x}")  # f-string in raise: NOT a finding
+    return y
+
+
+def caller():
+    return bad_debug(jnp.zeros(2), mode=["fast"])  # BITE unhashable static
+
+
+def specs():
+    return P(None, "model", None)  # BITE trailing-None PartitionSpec

@@ -1,0 +1,50 @@
+"""R2 bite fixture: host syncs in the wrong tick phases.
+
+Mirrors the engine's tick shape — a method that emits phase slices via
+``self.tracer.tick`` — with syncs planted in the dispatch phase and in
+a helper reached from it.  Parsed only, never executed.
+"""
+
+import numpy as np
+
+
+class FakeEngine:
+    def step(self):
+        t0 = self.tracer.now_us() if self.tracer is not None else -1.0
+        self._admit()
+        t1 = self.tracer.now_us() if self.tracer is not None else -1.0
+        nxt = self._dispatch_decode(self._tables())
+        depth = self.queue_depth.item()  # BITE .item() in dispatch phase
+        early = np.asarray(nxt)  # BITE asarray(dispatch result) pre-sync
+        nxt.block_until_ready()  # BITE block_until_ready
+        t2 = self.tracer.now_us() if self.tracer is not None else -1.0
+        nxt_host = np.asarray(nxt)  # designated host_sync: NOT a finding
+        t3 = self.tracer.now_us() if self.tracer is not None else -1.0
+        self._deliver(nxt_host, early, depth)
+        t4 = self.tracer.now_us() if self.tracer is not None else -1.0
+        if self.tracer is not None:
+            self.tracer.tick(t0, (
+                ("admission", t0, t1), ("decode_dispatch", t1, t2),
+                ("host_sync", t2, t3), ("deliver", t3, t4),
+            ))
+        return True
+
+    def _admit(self):
+        import jax
+
+        lens = self._lengths()
+        return jax.device_get(lens)  # BITE device_get in reached helper
+
+    def _tables(self):
+        return np.zeros((2, 2), np.int32)  # host packing: NOT a finding
+
+    def _lengths(self):
+        return [1, 2]
+
+    def _dispatch_decode(self, tables):
+        return tables
+
+    def _deliver(self, nxt_host, early, depth):
+        # deliver phase body in the tick is exempt; this helper is only
+        # reached from the exempt span, so it is not scanned
+        return int(nxt_host[0]) + depth

@@ -1,0 +1,53 @@
+"""R3 bite fixture: engine-owned state mutated off the engine thread,
+and lock-protected state mutated without its lock.
+
+Declares its own domain/lock annotations via the module-level
+``LINT_THREAD_DOMAINS`` / ``LINT_LOCKED_STATE`` literals — the same
+seeding mechanism the real tables use.  Parsed only, never executed.
+"""
+
+import threading
+
+LINT_THREAD_DOMAINS = {
+    "Handler.*": "loop",
+    "Watchdog.*": "supervisor",
+    "TickLoop.*": "engine",
+}
+
+LINT_LOCKED_STATE = {
+    "Counters": {"lock": "_lock", "attrs": ["ttft_s", "n_finished"]},
+}
+
+
+class Handler:
+    def on_request(self, req):
+        self.engine.scheduler.queue.append(req)  # BITE loop-domain mutation
+        self.engine.scheduler.finished.clear()  # BITE loop-domain mutation
+        depth = len(self.engine.scheduler.queue)  # benign read: NOT a finding
+        return depth
+
+
+class Watchdog:
+    def on_hang(self):
+        self.engine.pool.pages = None  # BITE supervisor-domain mutation
+
+
+class TickLoop:
+    def tick(self):
+        self.engine.scheduler.queue.append(1)  # engine domain: NOT a finding
+
+
+class Counters:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.ttft_s = []  # constructor: NOT a finding
+        self.n_finished = 0
+
+    def on_finish(self, ttft):
+        self.ttft_s.append(ttft)  # BITE mutation outside the owning lock
+        self.n_finished += 1  # BITE augassign outside the owning lock
+
+    def on_finish_locked(self, ttft):
+        with self._lock:
+            self.ttft_s.append(ttft)  # under the lock: NOT a finding
+            self.n_finished += 1

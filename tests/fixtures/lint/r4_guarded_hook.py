@@ -1,0 +1,22 @@
+"""R4 bite fixture: unguarded optional-hook calls and the cached-hook
+anti-pattern.  Parsed only, never executed."""
+
+
+class Engine:
+    def step_unguarded_attr(self):
+        self.tracer.instant("tick")  # BITE direct call, no is-None guard
+
+    def step_unguarded_local(self):
+        tr = self.tracer
+        tr.instant("tick")  # BITE local hook call, no is-None guard
+
+    def step_unguarded_faults(self):
+        if self.faults.trip("decode") is not None:  # BITE faults unguarded
+            raise RuntimeError("boom")
+
+    def step_guarded(self):
+        if self.tracer is not None:
+            self.tracer.instant("tick")  # guarded: NOT a finding
+        faults = self.faults
+        if faults is not None:
+            faults.trip("decode")  # guarded local: NOT a finding

@@ -1,0 +1,25 @@
+"""R5 bite fixture: a Pallas kernel reached without its probe gate, and
+a gated selection with no fallback sibling.  Parsed only."""
+
+from llm_np_cp_tpu.ops.pallas import flash_attention as fa_mod
+from llm_np_cp_tpu.ops.pallas.decode_attention import (
+    paged_decode_attention,
+    ragged_paged_attention,
+)
+from llm_np_cp_tpu.ops.pallas.support import kernel_available
+
+
+class BadEngine:
+    def decode(self, q, pages, tables, lengths, pads):
+        # unconditional kernel call — no probe, no fallback
+        return paged_decode_attention(q, pages, pages, tables, lengths, pads)  # BITE
+
+    def mixed(self, q, pages, meta):
+        if kernel_available("ragged_paged_attention"):
+            # probe-gated but the conditional dead-ends — no XLA sibling
+            # branch to degrade to
+            return ragged_paged_attention(q, pages, pages, *meta)  # BITE
+
+    def prefill(self, q, k, v):
+        # module-attribute access must not bypass the rule
+        return fa_mod.flash_attention(q, k, v, scale=0.1)  # BITE

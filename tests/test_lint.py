@@ -1,0 +1,231 @@
+"""tools/lint — the serve-stack static-analysis suite.
+
+Two halves, both tier-1:
+
+- the repo itself must be CLEAN (``python -m tools.lint`` exits 0) —
+  this is the pin that stops future PRs from reintroducing the bug
+  classes the rules encode;
+- every rule must demonstrably BITE: each known-bad fixture under
+  tests/fixtures/lint/ carries ``# BITE`` markers on the lines the rule
+  must flag, and the test asserts the findings land exactly there (a
+  lint that cannot fail pins nothing — the test_serve_tracing
+  discipline, now suite-wide).
+"""
+
+import pathlib
+import subprocess
+import sys
+
+import pytest
+
+from tools.lint.core import SourceFile, apply_suppressions
+from tools.lint.runner import RULES, resolve_targets, run_lint
+
+FIXTURES = pathlib.Path(__file__).parent / "fixtures" / "lint"
+
+BITE_FIXTURES = {
+    "R1": "r1_jit_hazard.py",
+    "R2": "r2_host_sync.py",
+    "R3": "r3_thread_affinity.py",
+    "R4": "r4_guarded_hook.py",
+    "R5": "r5_probe_gate.py",
+}
+
+
+def bite_lines(path: pathlib.Path) -> set[int]:
+    return {
+        i for i, line in enumerate(path.read_text().splitlines(), start=1)
+        if "# BITE" in line
+    }
+
+
+# ---------------------------------------------------------------------------
+# The suite itself
+# ---------------------------------------------------------------------------
+
+def test_all_rules_registered():
+    assert sorted(RULES) == ["R1", "R2", "R3", "R4", "R5"]
+    for rule in RULES.values():
+        assert rule.targets, f"{rule.id} has no target scope"
+
+
+def test_repo_is_clean():
+    """The acceptance pin: the full suite over its default scopes finds
+    nothing unsuppressed (suppressed findings carry their reasons in
+    the source)."""
+    findings = run_lint()
+    live = [f for f in findings if not f.suppressed]
+    assert not live, "lint findings:\n" + "\n".join(
+        f.format() for f in live
+    )
+
+
+def test_repo_suppressions_are_reasoned():
+    """Every suppressed finding in the repo carries a reason (the
+    reasonless-disable case is itself a LINT finding, covered above)."""
+    for f in run_lint():
+        if f.suppressed:
+            assert f.suppress_reason, f.format()
+
+
+@pytest.mark.parametrize("rule_id", sorted(BITE_FIXTURES))
+def test_rule_bites_its_fixture(rule_id):
+    """Each rule fires on its known-bad fixture, with the right rule id,
+    on exactly the marked lines — no misses, no extra noise."""
+    path = FIXTURES / BITE_FIXTURES[rule_id]
+    sf = SourceFile.load(path)
+    findings = RULES[rule_id].check(sf)
+    assert findings, f"{rule_id} found nothing in its bite fixture"
+    assert all(f.rule == rule_id for f in findings)
+    expected = bite_lines(path)
+    got = {f.line for f in findings}
+    assert got == expected, (
+        f"{rule_id}: flagged lines {sorted(got)} != "
+        f"BITE-marked {sorted(expected)}"
+    )
+
+
+# ---------------------------------------------------------------------------
+# Suppressions
+# ---------------------------------------------------------------------------
+
+def _r4_findings(tmp_path, text):
+    bad = tmp_path / "bad.py"
+    bad.write_text(text)
+    sf = SourceFile(bad, text)
+    return apply_suppressions(RULES["R4"].check(sf), sf)
+
+
+def test_suppression_with_reason_suppresses(tmp_path):
+    out = _r4_findings(tmp_path, (
+        "class E:\n"
+        "    def step(self):\n"
+        "        self.tracer.instant('t')"
+        "  # lint: disable=R4 -- fixture knows best\n"
+    ))
+    assert len(out) == 1 and out[0].suppressed
+    assert out[0].suppress_reason == "fixture knows best"
+
+
+def test_suppression_without_reason_is_a_finding(tmp_path):
+    out = _r4_findings(tmp_path, (
+        "class E:\n"
+        "    def step(self):\n"
+        "        self.tracer.instant('t')  # lint: disable=R4\n"
+    ))
+    assert {f.rule for f in out} == {"R4", "LINT"}
+    assert not any(f.suppressed for f in out)
+
+
+def test_standalone_suppression_covers_next_code_line(tmp_path):
+    out = _r4_findings(tmp_path, (
+        "class E:\n"
+        "    def step(self):\n"
+        "        # lint: disable=R4 -- spans a\n"
+        "        # multi-line explanation comment\n"
+        "        self.tracer.instant('t')\n"
+    ))
+    assert len(out) == 1 and out[0].suppressed
+    # continuation comment lines extend the recorded reason
+    assert out[0].suppress_reason == "spans a multi-line explanation comment"
+
+
+def test_suppression_for_other_rule_does_not_cover(tmp_path):
+    out = _r4_findings(tmp_path, (
+        "class E:\n"
+        "    def step(self):\n"
+        "        self.tracer.instant('t')  # lint: disable=R2 -- wrong id\n"
+    ))
+    # the R4 finding stays live AND the unmatched R2 directive is
+    # reported stale
+    assert {f.rule for f in out} == {"R4", "LINT"}
+    assert not any(f.suppressed for f in out)
+
+
+def test_stale_suppression_is_reported(tmp_path):
+    out = _r4_findings(tmp_path, (
+        "class E:\n"
+        "    def step(self):\n"
+        "        pass  # lint: disable=R4 -- nothing here fires\n"
+    ))
+    assert [f.rule for f in out] == ["LINT"]
+    assert "stale suppression" in out[0].message
+
+
+def test_stale_suppression_ignored_for_inactive_rules(tmp_path):
+    """A --rules subset run must not call other rules' suppressions
+    stale (R3 never ran, so its directive cannot be judged)."""
+    bad = tmp_path / "bad.py"
+    text = (
+        "class E:\n"
+        "    def step(self):\n"
+        "        pass  # lint: disable=R3 -- judged only when R3 runs\n"
+    )
+    bad.write_text(text)
+    sf = SourceFile(bad, text)
+    out = apply_suppressions(RULES["R4"].check(sf), sf,
+                             active_rules={"R4"})
+    assert out == []
+
+
+# ---------------------------------------------------------------------------
+# Scoping & CLI
+# ---------------------------------------------------------------------------
+
+def test_explicit_paths_respect_rule_scope():
+    """--changed hands the suite arbitrary files; each rule must only
+    run inside its own target scope."""
+    r2 = RULES["R2"]
+    hit = resolve_targets(r2, ["llm_np_cp_tpu/serve/engine.py",
+                               "llm_np_cp_tpu/serve/metrics.py"])
+    assert [p.name for p in hit] == ["engine.py"]
+    r3 = RULES["R3"]
+    hit = resolve_targets(r3, ["llm_np_cp_tpu/serve/metrics.py",
+                               "llm_np_cp_tpu/cache.py"])
+    assert [p.name for p in hit] == ["metrics.py"]
+
+
+def test_cli_clean_and_json():
+    from tools.lint.cli import main
+
+    assert main([]) == 0
+    assert main(["--json"]) == 0
+    assert main(["--list-rules"]) == 0
+    assert main(["--rules", "R9"]) == 2
+
+
+def test_cli_module_runs_without_jax():
+    """The lint is pure stdlib AST: `python -m tools.lint` must never
+    import jax (pre-commit speed, and it runs where jax can't)."""
+    out = subprocess.run(
+        [sys.executable, "-c",
+         "import sys; import tools.lint; import tools.lint.rules; "
+         "assert 'jax' not in sys.modules, 'lint imported jax'; "
+         "print('ok')"],
+        cwd=pathlib.Path(__file__).parent.parent,
+        capture_output=True, text=True,
+    )
+    assert out.returncode == 0, out.stderr
+    assert out.stdout.strip() == "ok"
+
+
+# ---------------------------------------------------------------------------
+# Back-compat: the migrated tracing-hooks lint
+# ---------------------------------------------------------------------------
+
+def test_compile_counter_shim_still_works(tmp_path):
+    """``tools.compile_counter.assert_tracing_hooks_guarded`` survives
+    as a deprecation shim over the R4 engine: same default scope, same
+    AssertionError shape (test_serve_tracing matches on 'without an')."""
+    from tools.compile_counter import assert_tracing_hooks_guarded
+
+    assert_tracing_hooks_guarded()  # repo hot paths stay guarded
+
+    bad = tmp_path / "bad_hot_path.py"
+    bad.write_text(
+        "class Engine:\n"
+        "    def step(self):\n"
+        "        self.tracer.instant('tick')\n"
+    )
+    with pytest.raises(AssertionError, match="without an"):
+        assert_tracing_hooks_guarded((str(bad),))

@@ -14,11 +14,11 @@ probes:
   temp prefill cache is padded to a fixed capacity), scatter once per
   distinct prefill block count (phase shapes), never per tick.
 - ``assert_tracing_hooks_guarded()`` — the tracing-off discipline lint:
-  an AST pass over the serve hot-path modules asserting every
-  ``serve/tracing.py`` hook sits behind an ``is None`` check, so with
-  tracing off the per-tick cost is attribute loads + branches — no
-  Python allocations and no calls on the hot path (the FaultInjector
-  discipline, now pinned instead of promised).
+  every ``serve/tracing.py`` hook must sit behind an ``is None`` check,
+  so with tracing off the per-tick cost is attribute loads + branches —
+  no Python allocations and no calls on the hot path.  Now a shim over
+  rule R4 of the static-analysis suite (``python -m tools.lint``),
+  which generalizes it to the FaultInjector hook and all serve modules.
 
 Run from tests (tests/test_serve_static_shapes.py,
 tests/test_serve_tracing.py); usable standalone:
@@ -28,9 +28,7 @@ tests/test_serve_tracing.py); usable standalone:
 
 from __future__ import annotations
 
-import ast
 import contextlib
-import pathlib
 from typing import Iterator
 
 # Event keys that indicate an XLA computation was compiled.  jax renamed
@@ -172,76 +170,18 @@ _TRACED_HOT_PATHS = (
 
 def assert_tracing_hooks_guarded(files: tuple[str, ...] = _TRACED_HOT_PATHS,
                                  ) -> None:
-    """The tracing-off zero-overhead lint.
+    """The tracing-off zero-overhead lint — DEPRECATION SHIM.
 
-    For every function in the hot-path modules: any call through a
-    tracer binding — a local assigned from ``<x>.tracer`` or
-    ``getattr(<x>, "tracer", ...)``, or a direct ``<x>.tracer.<m>()``
-    attribute chain — must be accompanied by an ``is None`` /
-    ``is not None`` comparison on that binding in the same function.
-    This is what makes tracing-off a branch instead of work: no dict or
-    tuple is built for a recorder that is not there, and the decode/
-    prefill hot loop allocates nothing it did not allocate before
-    tracing existed.
+    The AST pass that lived here is now rule **R4 (guarded-hook)** of
+    the serve-stack static-analysis suite (``python -m tools.lint``),
+    which extends it to the FaultInjector hook and every serve hot-path
+    module.  This wrapper keeps the original surface for existing
+    callers/tests: same default files, same AssertionError text shape
+    (``... without an 'is (not) None' guard``), tracer hook only.
     """
-    root = pathlib.Path(__file__).resolve().parent.parent
-    problems: list[str] = []
-    for rel in files:
-        path = root / rel
-        tree = ast.parse(path.read_text())
-        for fn in (n for n in ast.walk(tree)
-                   if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))):
-            tracer_locals: set[str] = set()
-            attr_guarded = False
-            name_guarded: set[str] = set()
-            for node in ast.walk(fn):
-                if isinstance(node, ast.Assign):
-                    v = node.value
-                    is_tracer = (
-                        isinstance(v, ast.Attribute) and v.attr == "tracer"
-                    ) or (
-                        isinstance(v, ast.Call)
-                        and isinstance(v.func, ast.Name)
-                        and v.func.id == "getattr"
-                        and len(v.args) >= 2
-                        and isinstance(v.args[1], ast.Constant)
-                        and v.args[1].value == "tracer"
-                    )
-                    if is_tracer:
-                        for t in node.targets:
-                            if isinstance(t, ast.Name):
-                                tracer_locals.add(t.id)
-                elif isinstance(node, ast.Compare) and any(
-                    isinstance(op, (ast.Is, ast.IsNot)) for op in node.ops
-                ) and any(
-                    isinstance(c, ast.Constant) and c.value is None
-                    for c in node.comparators
-                ):
-                    if isinstance(node.left, ast.Name):
-                        name_guarded.add(node.left.id)
-                    elif (isinstance(node.left, ast.Attribute)
-                          and node.left.attr == "tracer"):
-                        attr_guarded = True
-            for node in ast.walk(fn):
-                if not (isinstance(node, ast.Call)
-                        and isinstance(node.func, ast.Attribute)):
-                    continue
-                base = node.func.value
-                if isinstance(base, ast.Attribute) and base.attr == "tracer":
-                    if not attr_guarded:
-                        problems.append(
-                            f"{rel}:{node.lineno}: .tracer."
-                            f"{node.func.attr}() in {fn.name}() without an "
-                            "'is (not) None' guard on the tracer attribute"
-                        )
-                elif (isinstance(base, ast.Name)
-                      and base.id in tracer_locals
-                      and base.id not in name_guarded):
-                    problems.append(
-                        f"{rel}:{node.lineno}: tracer local {base.id!r} "
-                        f"called in {fn.name}() without an "
-                        "'is (not) None' guard"
-                    )
+    from tools.lint.rules.guarded_hook import scan_hook_guard_files
+
+    problems = scan_hook_guard_files(tuple(files), hooks=("tracer",))
     if problems:
         raise AssertionError(
             "tracing-off zero-overhead lint failed:\n  "

@@ -1,0 +1,45 @@
+"""Serve-stack static analysis (`python -m tools.lint`).
+
+The serving engine's hard-won invariants — zero recompiles across
+ticks, is-None-guarded optional hooks, engine-thread-only mutation of
+scheduler/pool state, every Pallas kernel call behind its support.py
+probe gate with an XLA fallback — were historically enforced only by
+runtime compile-counter bounds and one bespoke AST check.  This package
+enforces them at SOURCE, so a PR reintroducing a known bug class (the
+trailing-None PartitionSpec recompile, an unguarded tracer hook, a host
+sync inside the dispatch phase) fails lint before it ever ticks an
+engine.
+
+Rules (each in tools/lint/rules/):
+
+- **R1 jit-hazard**     — inside jit-traced functions: Python if/while
+  on traced values, print/f-strings, unhashable static args; plus the
+  raw trailing-None ``PartitionSpec`` spelling in serve/ code that
+  ``parallel/sharding.normalize_specs`` exists to launder.
+- **R2 host-sync**      — device→host syncs (``.item()``, ``np.asarray``
+  on dispatch results, ``jax.device_get``, ``block_until_ready``) in
+  engine tick phases other than the designated ``host_sync``/``deliver``
+  phase bodies.
+- **R3 thread-affinity**— engine-thread-owned state (scheduler queues,
+  pool free list) mutated off the engine domain, and lock-protected
+  state (metrics internals, supervisor ledgers) mutated outside its
+  owning lock; domains seeded from an annotation table.
+- **R4 guarded-hook**   — optional hot-path hooks (tracer, faults) must
+  sit behind an ``is None`` check; ``self.tracer``/``self.metrics`` must
+  not be cached in locals on engine tick paths (the supervisor's
+  zombie-mute discipline).
+- **R5 probe-gate**     — serve code may reach a Pallas kernel only
+  behind its support.py probe gate, with an XLA fallback sibling.
+
+Suppression: ``# lint: disable=R2 -- reason`` on (or immediately above)
+the offending line.  The reason is REQUIRED — a bare disable is itself
+a finding.
+
+Pure stdlib + AST: importing this package must stay jax-free so the
+lint runs in milliseconds anywhere (pre-commit, CI, tests).
+"""
+
+from tools.lint.core import Finding, SourceFile
+from tools.lint.runner import RULES, run_lint
+
+__all__ = ["Finding", "SourceFile", "RULES", "run_lint"]

@@ -1,0 +1,109 @@
+"""``python -m tools.lint`` — the serve-stack static-analysis CLI.
+
+    python -m tools.lint                  # full suite over default scopes
+    python -m tools.lint --changed        # only files touched vs HEAD
+    python -m tools.lint --rules R2,R4    # subset of rules
+    python -m tools.lint --json           # machine output
+    python -m tools.lint --list-rules     # rule table
+    python -m tools.lint path/a.py ...    # explicit files (scope-filtered)
+
+Exit status: 0 clean (suppressed findings allowed), 1 findings, 2 usage.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import subprocess
+import sys
+
+from tools.lint.core import REPO_ROOT
+from tools.lint.runner import RULES, run_lint
+
+
+def changed_files() -> list[str]:
+    """Python files changed vs HEAD (worktree + index) plus untracked —
+    the fast pre-commit scope."""
+    out: set[str] = set()
+    for cmd in (
+        ["git", "diff", "--name-only", "HEAD"],
+        ["git", "ls-files", "--others", "--exclude-standard"],
+    ):
+        res = subprocess.run(
+            cmd, cwd=REPO_ROOT, capture_output=True, text=True, check=False,
+        )
+        if res.returncode == 0:
+            out.update(
+                line.strip() for line in res.stdout.splitlines()
+                if line.strip().endswith(".py")
+            )
+    return sorted(out)
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.lint",
+        description="serve-stack static analysis "
+                    "(jit-hazard / host-sync / thread-affinity / "
+                    "guarded-hook / probe-gate)",
+    )
+    ap.add_argument("paths", nargs="*", help="explicit files to lint "
+                    "(each rule still applies only within its scope)")
+    ap.add_argument("--rules", help="comma-separated rule ids (default all)")
+    ap.add_argument("--changed", action="store_true",
+                    help="lint only files changed vs HEAD (+ untracked)")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="machine-readable output")
+    ap.add_argument("--list-rules", action="store_true")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for rid in sorted(RULES):
+            rule = RULES[rid]
+            scopes = ", ".join(rule.targets)
+            print(f"{rid}  {rule.name:<16} {scopes}")
+        return 0
+
+    rules = None
+    if args.rules:
+        rules = [r.strip() for r in args.rules.split(",") if r.strip()]
+        unknown = [r for r in rules if r not in RULES]
+        if unknown:
+            print(f"unknown rule(s): {', '.join(unknown)} "
+                  f"(known: {', '.join(sorted(RULES))})", file=sys.stderr)
+            return 2
+
+    paths: list[str] | None = args.paths or None
+    if args.changed:
+        paths = sorted(set(paths or []) | set(changed_files()))
+        if not paths:
+            print("lint: no changed python files")
+            return 0
+
+    findings = run_lint(paths=paths, rules=rules)
+    live = [f for f in findings if not f.suppressed]
+    suppressed = [f for f in findings if f.suppressed]
+
+    if args.as_json:
+        print(json.dumps({
+            "ok": not live,
+            "findings": [f.to_dict() for f in live],
+            "suppressed": [f.to_dict() for f in suppressed],
+        }, indent=2))
+        return 1 if live else 0
+
+    for f in findings:
+        print(f.format())
+    n_files = len({f.path for f in live})
+    if live:
+        print(f"\nlint: {len(live)} finding(s) across {n_files} file(s)"
+              + (f" ({len(suppressed)} suppressed)" if suppressed else ""))
+        return 1
+    print("lint: clean"
+          + (f" ({len(suppressed)} suppressed finding(s))"
+             if suppressed else ""))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,255 @@
+"""Shared lint infrastructure: findings, source files, suppressions.
+
+Everything here is plain stdlib AST — no jax, no repo imports — so the
+lint loads in milliseconds and can run before the environment can even
+build an engine.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import pathlib
+import re
+from typing import Any, Iterator
+
+REPO_ROOT = pathlib.Path(__file__).resolve().parent.parent.parent
+
+# ``# lint: disable=R1`` or ``# lint: disable=R1,R4 -- reason text``.
+# The reason (after `` -- ``) is REQUIRED: an unexplained suppression is
+# itself a finding (rule id LINT), and does not suppress anything.
+_SUPPRESS_RE = re.compile(
+    r"#\s*lint:\s*disable=([A-Za-z0-9_*,]+)(?:\s+--\s*(\S[^#]*))?"
+)
+
+
+@dataclasses.dataclass
+class Finding:
+    """One lint verdict, pinned to a source line."""
+
+    rule: str
+    path: str  # repo-relative when under the repo, else absolute
+    line: int
+    message: str
+    suppressed: bool = False
+    suppress_reason: str | None = None
+
+    def format(self) -> str:
+        tail = (
+            f"  [suppressed: {self.suppress_reason}]"
+            if self.suppressed else ""
+        )
+        return f"{self.path}:{self.line}: {self.rule}: {self.message}{tail}"
+
+    def to_dict(self) -> dict[str, Any]:
+        out = {
+            "rule": self.rule,
+            "path": self.path,
+            "line": self.line,
+            "message": self.message,
+        }
+        if self.suppressed:
+            out["suppressed"] = True
+            out["suppress_reason"] = self.suppress_reason
+        return out
+
+
+@dataclasses.dataclass
+class Suppression:
+    line: int  # line the directive appears on
+    target: int  # line the directive covers (itself, or next code line)
+    rules: set[str]  # rule ids, or {"*"}
+    reason: str | None
+    used: bool = False
+
+    def covers(self, rule: str, line: int) -> bool:
+        if rule not in self.rules and "*" not in self.rules:
+            return False
+        if self.reason is None:
+            return False  # reasonless disables never suppress
+        return line in (self.line, self.target)
+
+
+def parse_suppressions(text: str) -> list[Suppression]:
+    lines = text.splitlines()
+    out: list[Suppression] = []
+    for i, raw in enumerate(lines, start=1):
+        m = _SUPPRESS_RE.search(raw)
+        if not m:
+            continue
+        rules = {r.strip() for r in m.group(1).split(",") if r.strip()}
+        reason = m.group(2).strip() if m.group(2) else None
+        target = i
+        if raw[: m.start()].strip() == "":
+            # standalone directive, possibly the head of a multi-line
+            # comment: it covers the next CODE line, and the comment's
+            # continuation lines extend the reason
+            extra: list[str] = []
+            j = i
+            while j < len(lines):
+                nxt = lines[j].strip()
+                if nxt and not nxt.startswith("#"):
+                    target = j + 1
+                    break
+                if nxt.startswith("#"):
+                    extra.append(nxt.lstrip("#").strip())
+                j += 1
+            if reason is not None and extra:
+                reason = " ".join([reason] + extra)
+        out.append(Suppression(line=i, target=target, rules=rules,
+                               reason=reason))
+    return out
+
+
+class SourceFile:
+    """A parsed target: text, AST, parent links, and suppressions."""
+
+    def __init__(self, path: pathlib.Path, text: str) -> None:
+        self.path = path
+        try:
+            self.rel = str(path.resolve().relative_to(REPO_ROOT))
+        except ValueError:
+            self.rel = str(path)
+        self.text = text
+        self.tree = ast.parse(text)
+        self.parents: dict[ast.AST, ast.AST] = {}
+        for parent in ast.walk(self.tree):
+            for child in ast.iter_child_nodes(parent):
+                self.parents[child] = parent
+        self.suppressions = parse_suppressions(text)
+
+    @classmethod
+    def load(cls, path: str | pathlib.Path) -> "SourceFile":
+        p = pathlib.Path(path)
+        if not p.is_absolute():
+            p = REPO_ROOT / p
+        return cls(p, p.read_text())
+
+    # -- AST navigation -------------------------------------------------
+    def ancestors(self, node: ast.AST) -> Iterator[ast.AST]:
+        cur = self.parents.get(node)
+        while cur is not None:
+            yield cur
+            cur = self.parents.get(cur)
+
+    def enclosing_function(
+        self, node: ast.AST,
+    ) -> ast.FunctionDef | ast.AsyncFunctionDef | None:
+        for anc in self.ancestors(node):
+            if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                return anc
+        return None
+
+    def enclosing_class(self, node: ast.AST) -> ast.ClassDef | None:
+        for anc in self.ancestors(node):
+            if isinstance(anc, ast.ClassDef):
+                return anc
+        return None
+
+    def qualname(self, fn: ast.AST) -> str:
+        """Dotted name of a function: ``Class.method``, nested functions
+        as ``Class.method.inner``."""
+        parts = [getattr(fn, "name", "<expr>")]
+        for anc in self.ancestors(fn):
+            if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.ClassDef)):
+                parts.append(anc.name)
+        return ".".join(reversed(parts))
+
+    def iter_functions(
+        self,
+    ) -> Iterator[tuple[str, ast.FunctionDef | ast.AsyncFunctionDef]]:
+        for node in ast.walk(self.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield self.qualname(node), node
+
+
+def attr_chain(node: ast.AST) -> tuple[str, ...] | None:
+    """``self.pool.free_list`` → ``("self", "pool", "free_list")``; None
+    when the expression is not a plain dotted name chain (calls and
+    subscripts are opaque links: ``a().b`` / ``a[i].b`` → None)."""
+    parts: list[str] = []
+    cur = node
+    while isinstance(cur, ast.Attribute):
+        parts.append(cur.attr)
+        cur = cur.value
+    if isinstance(cur, ast.Name):
+        parts.append(cur.id)
+        return tuple(reversed(parts))
+    return None
+
+
+def call_name(node: ast.Call) -> tuple[str, ...] | None:
+    """Dotted chain of a call's callee, or None."""
+    return attr_chain(node.func)
+
+
+def walk_within(fn: ast.AST, *, skip_nested: bool = False) -> Iterator[ast.AST]:
+    """Walk a function body; ``skip_nested`` stops at inner function
+    boundaries (their bodies are someone else's scope)."""
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        yield node
+        if skip_nested and isinstance(
+            node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+        ):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def assigned_names(target: ast.AST) -> list[str]:
+    """Plain Name targets of an assignment target (tuples unpacked)."""
+    if isinstance(target, ast.Name):
+        return [target.id]
+    if isinstance(target, (ast.Tuple, ast.List)):
+        out: list[str] = []
+        for elt in target.elts:
+            out.extend(assigned_names(elt))
+        return out
+    return []
+
+
+def apply_suppressions(
+    findings: list[Finding], sf: SourceFile,
+    active_rules: set[str] | None = None,
+) -> list[Finding]:
+    """Mark findings covered by a valid same-file suppression; append a
+    LINT finding for every reasonless or unused directive.
+
+    ``active_rules`` names the rules that actually RAN on this file —
+    an unused directive is only reported when its rule was among them
+    (a ``--rules R2`` run must not call an R4 suppression stale)."""
+    for f in findings:
+        if f.path != sf.rel:
+            continue
+        for sup in sf.suppressions:
+            if sup.covers(f.rule, f.line):
+                f.suppressed = True
+                f.suppress_reason = sup.reason
+                sup.used = True
+                break
+    out = list(findings)
+    for sup in sf.suppressions:
+        if sup.reason is None:
+            out.append(Finding(
+                rule="LINT", path=sf.rel, line=sup.line,
+                message=(
+                    "suppression needs a reason: "
+                    "'# lint: disable=RULE -- why this is safe'"
+                ),
+            ))
+        elif not sup.used and (
+            active_rules is None
+            or "*" in sup.rules
+            or sup.rules & active_rules
+        ):
+            out.append(Finding(
+                rule="LINT", path=sf.rel, line=sup.line,
+                message=(
+                    f"stale suppression: disable="
+                    f"{','.join(sorted(sup.rules))} matched no finding "
+                    "— delete it so it cannot mask a future one"
+                ),
+            ))
+    return out

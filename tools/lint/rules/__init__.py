@@ -1,0 +1,19 @@
+"""Rule modules.  Each exports ``RULE``: an object with ``id``,
+``name``, ``targets`` (repo-relative globs) and ``check(SourceFile)``.
+"""
+
+from tools.lint.rules import (  # noqa: F401  (registration imports)
+    guarded_hook,
+    host_sync,
+    jit_hazard,
+    probe_gate,
+    thread_affinity,
+)
+
+ALL_RULES = (
+    jit_hazard.RULE,
+    host_sync.RULE,
+    thread_affinity.RULE,
+    guarded_hook.RULE,
+    probe_gate.RULE,
+)

@@ -1,0 +1,257 @@
+"""R1 — jit-hazard: trace-breaking Python inside jitted code, and the
+raw trailing-None PartitionSpec spelling in serve code.
+
+Inside any function that jax.jit traces (a ``@jax.jit`` /
+``@partial(jax.jit, ...)`` function, or a function nested inside one —
+scan/vmap bodies), the rule flags:
+
+- ``if`` / ``while`` / ternaries whose test involves a TRACED value
+  (a parameter of the traced function, or a closure over one).  Static
+  escapes are understood: ``.shape``/``.ndim``/``.dtype``/``.size``,
+  ``len()``/``isinstance()``, and ``is``/``is not`` comparisons (trace-
+  time identity on Python structure) don't count as traced uses.
+- ``print(...)`` — fires at trace time once, then never again; always a
+  debugging leftover.
+- f-strings outside ``raise``/``assert`` — formatting a tracer produces
+  ``Traced<...>`` garbage at trace time.
+- call sites of locally-jitted functions passing an unhashable literal
+  (list/dict/set display) for a ``static_argnums``/``static_argnames``
+  parameter — a guaranteed ``TypeError`` at first dispatch.
+
+Separately, in ``llm_np_cp_tpu/serve/`` (the consumers of
+``parallel/sharding.py``), any ``PartitionSpec``/``P`` constructed with
+a trailing literal ``None`` is flagged unless laundered through
+``normalize_specs``: GSPMD emits the normalized spelling on jit
+outputs, jit's compile cache compares shardings BY SPELLING, so a
+hand-spelled trailing None on an aval that round-trips through a step
+costs one spurious recompile (the PR-7 bug class).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.lint.core import (
+    Finding,
+    SourceFile,
+    attr_chain,
+    call_name,
+    walk_within,
+)
+
+RULE_ID = "R1"
+
+# attribute reads on a tracer that yield static (trace-time) values
+_STATIC_ATTRS = {"shape", "ndim", "dtype", "size", "device", "sharding",
+                 "aval", "itemsize"}
+_STATIC_CALLS = {"len", "isinstance", "getattr", "hasattr", "type"}
+_PSPEC_NAMES = {"P", "PartitionSpec"}
+_UNHASHABLE = (ast.List, ast.Dict, ast.Set, ast.ListComp, ast.DictComp,
+               ast.SetComp, ast.GeneratorExp)
+
+
+def _is_jit_decorator(dec: ast.AST) -> tuple[bool, set[int], set[str]]:
+    """→ (is jit, static_argnums, static_argnames) for one decorator."""
+
+    def ends_with_jit(node: ast.AST) -> bool:
+        chain = attr_chain(node)
+        return bool(chain) and chain[-1] == "jit"
+
+    if ends_with_jit(dec):
+        return True, set(), set()
+    if not isinstance(dec, ast.Call):
+        return False, set(), set()
+    is_jit = ends_with_jit(dec.func)
+    if not is_jit:
+        # functools.partial(jax.jit, ...)
+        chain = attr_chain(dec.func)
+        if chain and chain[-1] == "partial" and dec.args:
+            is_jit = ends_with_jit(dec.args[0])
+    if not is_jit:
+        return False, set(), set()
+    nums: set[int] = set()
+    names: set[str] = set()
+    for kw in dec.keywords:
+        vals = (
+            kw.value.elts if isinstance(kw.value, (ast.Tuple, ast.List))
+            else [kw.value]
+        )
+        consts = [v.value for v in vals if isinstance(v, ast.Constant)]
+        if kw.arg == "static_argnums":
+            nums |= {c for c in consts if isinstance(c, int)}
+        elif kw.arg == "static_argnames":
+            names |= {c for c in consts if isinstance(c, str)}
+    return True, nums, names
+
+
+def _jit_info(fn: ast.FunctionDef) -> tuple[bool, set[str]]:
+    """→ (is jitted, names of STATIC params)."""
+    for dec in fn.decorator_list:
+        is_jit, nums, names = _is_jit_decorator(dec)
+        if is_jit:
+            params = [a.arg for a in fn.args.args]
+            static = set(names)
+            static |= {params[i] for i in nums if i < len(params)}
+            return True, static
+    return False, set()
+
+
+def _test_uses_traced(node: ast.AST, traced: set[str]) -> bool:
+    """Does this test expression depend on a traced value, after pruning
+    the static escapes?"""
+    if isinstance(node, ast.Name):
+        return node.id in traced
+    if isinstance(node, ast.Attribute):
+        if node.attr in _STATIC_ATTRS:
+            return False
+        return _test_uses_traced(node.value, traced)
+    if isinstance(node, ast.Call):
+        chain = call_name(node)
+        if chain and chain[-1] in _STATIC_CALLS:
+            return False
+        return any(
+            _test_uses_traced(c, traced) for c in ast.iter_child_nodes(node)
+        )
+    if isinstance(node, ast.Compare):
+        if all(isinstance(op, (ast.Is, ast.IsNot)) for op in node.ops):
+            return False  # `x is None`: trace-time Python identity
+    return any(
+        _test_uses_traced(c, traced) for c in ast.iter_child_nodes(node)
+    )
+
+
+class _Rule:
+    id = RULE_ID
+    name = "jit-hazard"
+    targets = ("llm_np_cp_tpu/**/*.py",)
+
+    def check(self, sf: SourceFile) -> list[Finding]:
+        out: list[Finding] = []
+        statics_by_name: dict[str, set[str] | set[int]] = {}
+        # -- traced-code hazards --------------------------------------
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.FunctionDef):
+                continue
+            jitted, static = _jit_info(node)
+            if not jitted:
+                continue
+            params = {a.arg for a in node.args.args} | {
+                a.arg for a in node.args.kwonlyargs
+            }
+            if node.args.vararg:
+                params.add(node.args.vararg.arg)
+            statics_by_name[node.name] = static
+            self._check_traced(sf, node, params - static, out)
+        # -- unhashable static args at local call sites ----------------
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            chain = call_name(node)
+            if not chain or chain[-1] not in statics_by_name:
+                continue
+            static = statics_by_name[chain[-1]]
+            for kw in node.keywords:
+                if kw.arg in static and isinstance(kw.value, _UNHASHABLE):
+                    out.append(Finding(
+                        rule=self.id, path=sf.rel, line=kw.value.lineno,
+                        message=(
+                            f"unhashable literal for static arg "
+                            f"{kw.arg!r} of jitted {chain[-1]}() — "
+                            "TypeError at first dispatch; pass a tuple"
+                        ),
+                    ))
+        # -- trailing-None PartitionSpec in serve consumers ------------
+        # (parallel/sharding.py itself owns normalize_specs and its
+        # producers are laundered at their consumption sites; the hazard
+        # is serve code hand-spelling raw specs).  Fixtures opt in with
+        # a module-level ``LINT_PSPEC_CONSUMER = True``.
+        opt_in = any(
+            isinstance(n, ast.Assign)
+            and any(isinstance(t, ast.Name) and t.id == "LINT_PSPEC_CONSUMER"
+                    for t in n.targets)
+            for n in sf.tree.body
+        )
+        if sf.rel.startswith("llm_np_cp_tpu/serve/") or opt_in:
+            self._check_pspecs(sf, out)
+        return out
+
+    def _check_traced(self, sf: SourceFile, fn: ast.FunctionDef,
+                      traced: set[str], out: list[Finding]) -> None:
+        # nested defs are traced too (scan/vmap bodies); their params
+        # join the traced set along with closures over ours
+        for node in walk_within(fn, skip_nested=True):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                inner = traced | {a.arg for a in node.args.args}
+                self._check_traced(sf, node, inner, out)
+                continue
+            if isinstance(node, (ast.If, ast.While, ast.IfExp)):
+                if _test_uses_traced(node.test, traced):
+                    kind = {"If": "if", "While": "while",
+                            "IfExp": "ternary"}[type(node).__name__]
+                    out.append(Finding(
+                        rule=self.id, path=sf.rel, line=node.test.lineno,
+                        message=(
+                            f"Python {kind} on a traced value inside "
+                            f"jitted {fn.name}() — branches on tracers "
+                            "raise ConcretizationError; use lax.cond/"
+                            "jnp.where or hoist the value to a static arg"
+                        ),
+                    ))
+            elif isinstance(node, ast.Call):
+                chain = call_name(node)
+                if chain == ("print",):
+                    out.append(Finding(
+                        rule=self.id, path=sf.rel, line=node.lineno,
+                        message=(
+                            f"print() inside jitted {fn.name}() — runs "
+                            "once at trace time, never per step; use "
+                            "jax.debug.print or delete it"
+                        ),
+                    ))
+            elif isinstance(node, ast.JoinedStr):
+                if not any(isinstance(a, (ast.Raise, ast.Assert))
+                           for a in sf.ancestors(node)):
+                    out.append(Finding(
+                        rule=self.id, path=sf.rel, line=node.lineno,
+                        message=(
+                            f"f-string inside jitted {fn.name}() — "
+                            "formats Traced<...> at trace time (fine "
+                            "only in raise/assert messages)"
+                        ),
+                    ))
+
+    def _check_pspecs(self, sf: SourceFile, out: list[Finding]) -> None:
+        for node in ast.walk(sf.tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, (ast.Name, ast.Attribute))):
+                continue
+            chain = call_name(node)
+            if not chain or chain[-1] not in _PSPEC_NAMES:
+                continue
+            # syntactic check only: P(*entries) spreads are invisible
+            # here (build those without trailing Nones at the source)
+            if not node.args or not (
+                isinstance(node.args[-1], ast.Constant)
+                and node.args[-1].value is None
+            ):
+                continue
+            laundered = any(
+                isinstance(a, ast.Call)
+                and (call_name(a) or ("",))[-1] == "normalize_specs"
+                for a in sf.ancestors(node)
+            )
+            if not laundered:
+                out.append(Finding(
+                    rule=self.id, path=sf.rel, line=node.lineno,
+                    message=(
+                        "PartitionSpec spelled with a trailing None — "
+                        "GSPMD normalizes jit outputs, jit's cache "
+                        "compares shardings by spelling, so an aval that "
+                        "round-trips a step recompiles once; drop the "
+                        "trailing None or launder through "
+                        "parallel/sharding.normalize_specs"
+                    ),
+                ))
+
+
+RULE = _Rule()

@@ -1,0 +1,257 @@
+"""R3 — thread-affinity: an ownership checker over ``serve/``.
+
+The serve stack's threading contract (serve/http/server.py module
+docstring): the ENGINE THREAD owns the ``ServeEngine`` and everything
+under it — scheduler queues, the block pool free list — exclusively, so
+none of it is locked; the asyncio EVENT LOOP owns the HTTP handlers and
+talks to the engine only through the command queue; the SUPERVISOR
+watchdog owns crash/hang handling.  Cross-thread state (metrics
+counters, the runner's replay ledger) is lock-protected.
+
+The rule makes that contract machine-checked, seeded from the
+annotation tables below (precise, not heuristic):
+
+- ``DOMAIN_TABLE`` assigns every function a domain (``engine`` /
+  ``loop`` / ``supervisor`` / ``shared``) by (file, qualname) glob —
+  first match wins.  A linted module may extend/override with a
+  module-level ``LINT_THREAD_DOMAINS = {"Qualname.glob": "domain"}``
+  literal (how the bite fixture declares itself).
+- ``OWNED_STATE`` lists engine-thread-owned attributes by dotted-chain
+  suffix.  MUTATING them (assign/augassign/del, mutator method calls,
+  subscript stores) from a non-engine domain is a finding.  Plain reads
+  are deliberately not flagged: the stack's benign racy reads (queue
+  depth gauges for scrapes/routing) are part of the documented design.
+- ``LOCK_STATE`` lists lock-protected attribute groups.  Mutating one
+  outside a ``with <base>.<lock>:`` block is a finding unless the
+  function is in the group's ``lock_assumed`` set ("caller holds the
+  lock" helpers) or is the constructor.  Modules may declare
+  ``LINT_LOCKED_STATE = {"Class": {"lock": "_lock", "attrs": [...]}}``.
+"""
+
+from __future__ import annotations
+
+import ast
+import fnmatch
+
+from tools.lint.core import Finding, SourceFile, attr_chain, walk_within
+
+RULE_ID = "R3"
+
+# (path suffix glob, qualname glob, domain) — first match wins
+DOMAIN_TABLE: tuple[tuple[str, str, str], ...] = (
+    ("serve/http/server.py", "EngineRunner._loop*", "engine"),
+    ("serve/http/server.py", "EngineRunner._exec*", "engine"),
+    ("serve/http/server.py", "EngineRunner._run", "engine"),
+    ("serve/http/server.py", "EngineRunner._rebuild_and_replay*", "engine"),
+    ("serve/http/server.py", "EngineRunner._bridge*", "engine"),
+    ("serve/http/server.py", "EngineRunner._next_handback", "engine"),
+    ("serve/http/server.py", "EngineRunner._watch", "supervisor"),
+    ("serve/http/server.py", "EngineRunner._on_engine_death", "supervisor"),
+    ("serve/http/server.py", "EngineRunner._terminal_crash", "supervisor"),
+    ("serve/http/server.py", "*", "loop"),
+    ("serve/http/*.py", "*", "loop"),
+    ("serve/replica.py", "ReplicaRunner.*", "loop"),
+    ("serve/replica.py", "*", "engine"),
+    ("serve/metrics.py", "*", "shared"),
+    ("serve/tracing.py", "*", "shared"),
+    ("serve/faults.py", "*", "shared"),
+    ("serve/*.py", "*", "engine"),
+)
+
+# engine-thread-owned state, matched as a suffix of the access chain
+OWNED_STATE: tuple[tuple[str, ...], ...] = (
+    ("scheduler", "queue"),
+    ("scheduler", "running"),
+    ("scheduler", "finished"),
+    ("scheduler", "aborted"),
+    ("scheduler", "_free_slots"),
+    ("free_list", "_free"),
+    ("free_list", "_ref"),
+    ("pool", "pages"),
+    ("engine", "_requests"),
+    ("engine", "_detok"),
+)
+
+# lock-protected groups: attrs of a class that may only be MUTATED under
+# ``with self.<lock>:`` (or from a lock_assumed helper)
+LOCK_STATE: tuple[dict, ...] = (
+    {
+        "file": "serve/metrics.py",
+        "class": "ServeMetrics",
+        "lock": "_lock",
+        "attrs": {
+            "n_submitted", "n_finished", "n_aborted", "n_rejected",
+            "n_recovered", "n_ticks", "preemptions", "total_generated",
+            "finish_reasons", "ttft_s", "decode_tok_s", "queue_wait_s",
+            "prefill_s", "ttft_hist", "ttft_hist_sum", "decode_hist",
+            "decode_hist_sum", "queue_depth", "occupancy", "active_slots",
+            "kv_bytes_tick", "prefix_blocks_requested",
+            "prefix_blocks_hit", "mixed_prefill_tokens",
+            "mixed_decode_tokens", "t_start", "t_last",
+        },
+        # "caller holds the lock" helpers — annotated, not inferred
+        "lock_assumed": {"_record_latencies", "_trim"},
+    },
+    {
+        "file": "serve/http/server.py",
+        "class": "EngineRunner",
+        "lock": "_sup_lock",
+        "attrs": {
+            "_inflight", "_handback", "_recent_deaths", "_death_t",
+            "_backoff_delay", "recovering", "_gen",
+        },
+        "lock_assumed": {"_exec_inner", "_terminal_crash"},
+    },
+    {
+        "file": "serve/faults.py",
+        "class": "FaultInjector",
+        "lock": "_lock",
+        "attrs": {"hits", "injected", "_rngs"},
+        "lock_assumed": set(),
+    },
+)
+
+_MUTATORS = {
+    "append", "extend", "insert", "pop", "popleft", "appendleft", "clear",
+    "remove", "discard", "add", "update", "setdefault", "sort", "reverse",
+}
+
+
+def _module_overrides(sf: SourceFile, name: str) -> dict:
+    """Parse a module-level ``LINT_* = {literal}`` annotation."""
+    for node in sf.tree.body:
+        if (
+            isinstance(node, ast.Assign)
+            and len(node.targets) == 1
+            and isinstance(node.targets[0], ast.Name)
+            and node.targets[0].id == name
+        ):
+            try:
+                return ast.literal_eval(node.value)
+            except ValueError:
+                return {}
+    return {}
+
+
+def _domain_of(sf: SourceFile, qualname: str, overrides: dict) -> str:
+    for pat, dom in overrides.items():
+        if fnmatch.fnmatch(qualname, pat):
+            return dom
+    for file_glob, qual_glob, dom in DOMAIN_TABLE:
+        if fnmatch.fnmatch(sf.rel, "*" + file_glob) and fnmatch.fnmatch(
+            qualname, qual_glob
+        ):
+            return dom
+    return "engine"
+
+
+def _mutations(fn: ast.AST):
+    """Yield ``(chain, lineno, how)`` for every attribute-chain mutation
+    in the function's own body (nested defs are their own scope)."""
+    for node in walk_within(fn, skip_nested=True):
+        if isinstance(node, (ast.Assign, ast.AugAssign)):
+            targets = (
+                node.targets if isinstance(node, ast.Assign)
+                else [node.target]
+            )
+            for t in targets:
+                elts = t.elts if isinstance(t, (ast.Tuple, ast.List)) else [t]
+                for el in elts:
+                    if isinstance(el, ast.Subscript):
+                        el = el.value
+                    chain = attr_chain(el)
+                    if chain and len(chain) > 1:
+                        yield chain, node.lineno, "assignment"
+        elif isinstance(node, ast.Delete):
+            for t in node.targets:
+                if isinstance(t, ast.Subscript):
+                    t = t.value
+                chain = attr_chain(t)
+                if chain and len(chain) > 1:
+                    yield chain, node.lineno, "del"
+        elif isinstance(node, ast.Call):
+            if isinstance(node.func, ast.Attribute) \
+                    and node.func.attr in _MUTATORS:
+                chain = attr_chain(node.func.value)
+                if chain and len(chain) > 1:
+                    yield chain, node.lineno, f".{node.func.attr}()"
+
+
+def _under_lock(sf: SourceFile, node_line: int, fn: ast.AST,
+                base: tuple[str, ...], lock: str) -> bool:
+    """Is the line inside a ``with <base>.<lock>:`` block of ``fn``?"""
+    want = base + (lock,)
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.With):
+            continue
+        for item in node.items:
+            if attr_chain(item.context_expr) == want:
+                end = getattr(node, "end_lineno", node.lineno)
+                if node.lineno <= node_line <= end:
+                    return True
+    return False
+
+
+class _Rule:
+    id = RULE_ID
+    name = "thread-affinity"
+    targets = ("llm_np_cp_tpu/serve/**/*.py",)
+
+    def check(self, sf: SourceFile) -> list[Finding]:
+        out: list[Finding] = []
+        dom_over = _module_overrides(sf, "LINT_THREAD_DOMAINS")
+        lock_over = _module_overrides(sf, "LINT_LOCKED_STATE")
+        lock_groups = list(LOCK_STATE) + [
+            {"file": sf.rel, "class": cls, "lock": spec["lock"],
+             "attrs": set(spec["attrs"]),
+             "lock_assumed": set(spec.get("lock_assumed", ()))}
+            for cls, spec in lock_over.items()
+        ]
+        for qualname, fn in sf.iter_functions():
+            domain = _domain_of(sf, qualname, dom_over)
+            fn_name = qualname.rsplit(".", 1)[-1]
+            cls_name = qualname.split(".")[0] if "." in qualname else None
+            for chain, lineno, how in _mutations(fn):
+                # -- engine-owned state off the engine thread ----------
+                if domain != "engine":
+                    for suffix in OWNED_STATE:
+                        if chain[-len(suffix):] == suffix:
+                            out.append(Finding(
+                                rule=self.id, path=sf.rel, line=lineno,
+                                message=(
+                                    f"{how} on engine-thread-owned state "
+                                    f"'{'.'.join(chain)}' from "
+                                    f"{domain}-domain {qualname}() — "
+                                    "route through the engine command "
+                                    "queue instead"
+                                ),
+                            ))
+                            break
+                # -- lock-protected state outside its lock -------------
+                for grp in lock_groups:
+                    if cls_name != grp["class"] \
+                            or not sf.rel.endswith(grp["file"]):
+                        continue
+                    if len(chain) < 2 or chain[-1] not in grp["attrs"]:
+                        continue
+                    if fn_name == "__init__" \
+                            or fn_name in grp["lock_assumed"]:
+                        continue
+                    base = chain[:-1]
+                    if not _under_lock(sf, lineno, fn, base, grp["lock"]):
+                        out.append(Finding(
+                            rule=self.id, path=sf.rel, line=lineno,
+                            message=(
+                                f"{how} on lock-protected "
+                                f"'{'.'.join(chain)}' outside "
+                                f"'with {'.'.join(base)}."
+                                f"{grp['lock']}:' in {qualname}() — "
+                                "take the owning lock or add the "
+                                "function to the rule's lock_assumed "
+                                "annotation with a comment saying why"
+                            ),
+                        ))
+        return out
+
+
+RULE = _Rule()

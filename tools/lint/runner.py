@@ -1,0 +1,85 @@
+"""Rule registry + orchestration."""
+
+from __future__ import annotations
+
+import fnmatch
+import pathlib
+
+from tools.lint.core import (
+    REPO_ROOT,
+    Finding,
+    SourceFile,
+    apply_suppressions,
+)
+from tools.lint.rules import ALL_RULES
+
+RULES = {rule.id: rule for rule in ALL_RULES}
+
+
+def _matches(rel: str, globs: tuple[str, ...]) -> bool:
+    # fnmatch has no ``**`` semantics: try each pattern both as-is (its
+    # ``*`` already crosses slashes) and with ``**/`` elided so
+    # ``serve/**/*.py`` also matches serve/engine.py, like Path.glob
+    return any(
+        fnmatch.fnmatch(rel, g) or fnmatch.fnmatch(rel, g.replace("**/", ""))
+        for g in globs
+    )
+
+
+def resolve_targets(
+    rule, paths: list[str] | None,
+) -> list[pathlib.Path]:
+    """Files a rule runs on: its target globs, intersected with an
+    explicit path list (e.g. ``--changed``) when one is given."""
+    if paths is None:
+        out: set[pathlib.Path] = set()
+        for glob in rule.targets:
+            out.update(REPO_ROOT.glob(glob))
+        return sorted(p for p in out if p.is_file())
+    picked = []
+    for p in paths:
+        path = pathlib.Path(p)
+        if not path.is_absolute():
+            path = REPO_ROOT / p
+        try:
+            rel = str(path.resolve().relative_to(REPO_ROOT))
+        except ValueError:
+            rel = str(path)
+        if _matches(rel, rule.targets) and path.is_file():
+            picked.append(path)
+    return sorted(set(picked))
+
+
+def run_lint(
+    paths: list[str] | None = None,
+    rules: list[str] | None = None,
+) -> list[Finding]:
+    """Run the suite; returns every finding (suppressed ones marked).
+    ``paths=None`` → each rule's default targets; otherwise rules run
+    only on listed files matching their scope."""
+    selected = [RULES[r] for r in (rules or sorted(RULES))]
+    cache: dict[pathlib.Path, SourceFile] = {}
+    findings: list[Finding] = []
+    touched: dict[str, SourceFile] = {}
+    for rule in selected:
+        for path in resolve_targets(rule, paths):
+            sf = cache.get(path)
+            if sf is None:
+                sf = cache[path] = SourceFile.load(path)
+            touched[sf.rel] = sf
+            findings.extend(rule.check(sf))
+    # suppressions are applied per file over the combined findings (a
+    # line may carry several rules' verdicts); LINT findings for
+    # reasonless directives are appended once per file
+    out: list[Finding] = []
+    by_file: dict[str, list[Finding]] = {}
+    for f in findings:
+        by_file.setdefault(f.path, []).append(f)
+    active = {rule.id for rule in selected}
+    for rel, sf in sorted(touched.items()):
+        out.extend(apply_suppressions(by_file.pop(rel, []), sf,
+                                      active_rules=active))
+    for rest in by_file.values():  # findings on files we didn't parse
+        out.extend(rest)
+    out.sort(key=lambda f: (f.path, f.line, f.rule))
+    return out
