@@ -250,6 +250,26 @@ SERVE_SHARDED_CONFIGS = {
                                 tp=2, dp=(2, 2)),
 }
 
+# Durable-journal restart leg (serve/journal.py + tools/serve_proc.py):
+# REAL server subprocesses, three legs on identical arrivals — plain
+# (no journal), journaled (same trace; the delta IS the journal's
+# cost: client tok/s regression + off-thread fsync p99 from the
+# scrape), and a kill -9 leg (chaos proc_kill SIGKILLs the server
+# mid-decode; the parent respawns it on the same port + journal and
+# every client resumes via Last-Event-ID).  Observables: token parity
+# across the kill (journal replay is teacher-forced, so streams must
+# be byte-identical to the plain leg), restart-to-first-resumed-token
+# latency (client-observed: cut → first resumed token, including the
+# respawned process's model build), and the journal overhead pair.
+SERVE_RESTART_CONFIGS = {
+    "serve_restart_poisson": dict(model="llama1b", requests=32, rate=16.0,
+                                  prompt_len=512, max_tokens=64, slots=8,
+                                  block_size=128, kill_tick=90),
+    "smoke_serve_restart": dict(model="tiny", requests=8, rate=50.0,
+                                prompt_len=16, max_tokens=8, slots=2,
+                                block_size=8, kill_tick=14),
+}
+
 SPEC_CONFIGS = {
     # batched self-speculation: bf16 target + int8 self-draft, γ=4
     "int8_spec_bs8": dict(model="llama1b", batch=8, prompt_len=128,
@@ -288,6 +308,7 @@ PRIORITY = [
     "serve_mixed_poisson",  # unified ragged tick vs phase-split head-to-head
     "serve_http_poisson",  # HTTP front-end overhead vs direct engine calls
     "serve_chaos_poisson",  # supervised recovery under a seeded fault schedule
+    "serve_restart_poisson",  # kill -9 + journal replay + client resume
     "serve_sharded_poisson",  # TP pool sharding + DP replicas vs single chip
     "gemma2_2b_bs8",      # Gemma north-star number (VERDICT task 3)
     "int8_bs8",           # roofline-gap anchor (VERDICT task 6)
@@ -320,6 +341,7 @@ assert set(PRIORITY) == {
     + list(PREFILL_CONFIGS) + list(RAGGED_CONFIGS) + list(SERVE_CONFIGS)
     + list(SERVE_HTTP_CONFIGS) + list(SERVE_CHAOS_CONFIGS)
     + list(SERVE_MIXED_CONFIGS) + list(SERVE_SHARDED_CONFIGS)
+    + list(SERVE_RESTART_CONFIGS)
     if not n.startswith("smoke")
 } | EXTRA_CHILDREN, "PRIORITY out of sync with config dicts"
 
@@ -352,6 +374,10 @@ TIMEOUTS = {
     # the sharded legs re-place params + pool per topology and the DP
     # leg warms every replica
     "serve_sharded_poisson": 850,
+    # FOUR server subprocesses (plain / journaled / kill / restart),
+    # each paying its own model build + warmup, plus the realtime
+    # client traffic spans
+    "serve_restart_poisson": 1100,
     # prefill-dominated: the marginal measurement's extra prefill+half
     # decode per rep nearly doubles measured-phase wall time
     "llama3b_seq2048_bs8": 700,
@@ -1518,6 +1544,238 @@ def run_serve_chaos_config(name: str) -> dict:
     }
 
 
+def _spawn_serve_proc(spec, tmp, tag, *, port=0, journal=None,
+                      chaos=None, timeout=600.0):
+    """Spawn tools/serve_proc.py (deterministic random-weight model, so
+    a restarted process serves the identical model) and wait for its
+    port file → ``(proc, host, port)``."""
+    pf = os.path.join(tmp, f"port_{tag}")
+    cmd = [
+        sys.executable, os.path.join(REPO, "tools", "serve_proc.py"),
+        "--model", spec["model"], "--port", str(port), "--port-file", pf,
+        "--slots", str(spec["slots"]),
+        "--block-size", str(spec["block_size"]),
+        "--prompt-len", str(spec["prompt_len"]),
+        "--max-tokens", str(spec["max_tokens"]),
+    ]
+    plat = os.environ.get("BENCH_PLATFORM")
+    if plat:
+        cmd += ["--platform", plat]
+    if journal:
+        cmd += ["--journal", journal]
+    if chaos:
+        cmd += ["--chaos", chaos]
+    log_path = os.path.join(tmp, f"log_{tag}")
+    proc = subprocess.Popen(cmd, stdout=open(log_path, "w"),
+                            stderr=subprocess.STDOUT, cwd=REPO)
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if proc.poll() is not None:
+            raise RuntimeError(
+                f"serve_proc {tag} died at startup: "
+                + open(log_path).read()[-1500:])
+        if os.path.exists(pf):
+            host, port_s = open(pf).read().split()
+            return proc, host, int(port_s)
+        time.sleep(0.1)
+    proc.kill()
+    raise RuntimeError(f"serve_proc {tag} never wrote its port file")
+
+
+def run_serve_restart_config(name: str) -> dict:
+    """kill -9 durability: REAL server subprocesses, one Poisson trace,
+    three legs — plain (no journal), journaled (the overhead leg: the
+    client tok/s delta + the writer thread's fsync p99 IS the journal's
+    cost), and a kill leg (chaos ``proc_kill`` SIGKILLs the server
+    mid-decode; the parent respawns it on the same port + journal and
+    every client resumes its stream via Last-Event-ID).  Token parity
+    across ALL legs is the teacher-forced replay contract applied to
+    process death."""
+    import asyncio
+    import re as _re
+    import signal as _signal
+    import tempfile
+
+    import numpy as np
+
+    from llm_np_cp_tpu.config import LLAMA_3_2_1B, tiny_config
+    from llm_np_cp_tpu.serve import poisson_trace, scan_journal
+    from llm_np_cp_tpu.serve.http.client import (
+        astream_completion,
+        http_get,
+    )
+
+    t0 = time.perf_counter()
+    spec = SERVE_RESTART_CONFIGS[name]
+    config = {"llama1b": LLAMA_3_2_1B,
+              "tiny": tiny_config("llama")}[spec["model"]]
+    rng = np.random.default_rng(13)
+    trace = poisson_trace(
+        rng, spec["requests"], rate_rps=spec["rate"],
+        prompt_len_range=(max(spec["prompt_len"] // 4, 1),
+                          spec["prompt_len"]),
+        max_new_tokens=spec["max_tokens"], vocab_size=config.vocab_size,
+        seed_base=13,
+    )
+    client_timeout = TIMEOUTS.get(name, DEFAULT_TIMEOUT) / 4
+
+    def drive(host, port, *, retries):
+        async def leg():
+            async def one(item):
+                await asyncio.sleep(item["arrival_s"])
+                return await astream_completion(
+                    host, port,
+                    {"model": spec["model"],
+                     "prompt": [int(t) for t in item["prompt"]],
+                     "max_tokens": item["max_new_tokens"],
+                     "seed": item.get("seed", 0)},
+                    timeout=client_timeout, retries=retries,
+                    backoff_s=0.3, max_backoff_s=2.0,
+                )
+            t_leg = time.perf_counter()
+            results = await asyncio.gather(
+                *(one(item) for item in trace))
+            return results, time.perf_counter() - t_leg
+        return asyncio.run(leg())
+
+    def leg_stats(results, wall):
+        ok = [r for r in results if r["status"] == 200]
+        ttft = [r["ttft_s"] for r in ok if r["ttft_s"]]
+        toks = sum(len(r["token_ids"]) for r in ok)
+        return {
+            "completed": len(ok),
+            "client_tok_s": round(toks / wall, 1) if wall > 0 else 0.0,
+            "ttft_s_p50": round(_client_pct(ttft, 50), 4),
+            "ttft_s_p99": round(_client_pct(ttft, 99), 4),
+        }
+
+    tmp = tempfile.mkdtemp(prefix="serve_restart_")
+
+    def scrape(host, port, pattern):
+        _, raw = http_get(host, port, "/metrics")
+        m = _re.search(pattern, raw.decode(), _re.M)
+        return float(m.group(1)) if m else None
+
+    # -- leg 1: plain (no journal) — the baseline every delta reads from
+    proc, host, port = _spawn_serve_proc(spec, tmp, "plain")
+    try:
+        plain_results, plain_wall = drive(host, port, retries=2)
+    finally:
+        proc.send_signal(_signal.SIGTERM)
+        proc.wait(timeout=90)
+    plain_tokens = [r["token_ids"] for r in plain_results]
+    _phase(name, "plain_done", t0)
+
+    # -- leg 2: journaled — same trace; the delta is the journal's cost
+    j_overhead = os.path.join(tmp, "overhead.journal")
+    proc, host, port = _spawn_serve_proc(
+        spec, tmp, "journaled", journal=j_overhead)
+    try:
+        jr_results, jr_wall = drive(host, port, retries=2)
+        fsync_p99 = scrape(host, port,
+                           r"^llm_serve_journal_fsync_p99_s (\S+)")
+        records = scrape(host, port,
+                         r"^llm_serve_journal_records_total (\S+)")
+    finally:
+        proc.send_signal(_signal.SIGTERM)
+        proc.wait(timeout=90)
+    journaled_parity = [r["token_ids"] for r in jr_results] == plain_tokens
+    _phase(name, "journaled_done", t0)
+
+    # -- leg 3: kill -9 mid-decode, respawn on the same port + journal,
+    # clients resume via Last-Event-ID
+    j_kill = os.path.join(tmp, "kill.journal")
+    proc1, host, port = _spawn_serve_proc(
+        spec, tmp, "kill", journal=j_kill,
+        chaos=f"proc_kill@{spec['kill_tick']}")
+    killed_at: dict = {}
+    respawned: dict = {}
+
+    def respawn_when_dead():
+        proc1.wait()
+        killed_at["t"] = time.perf_counter()
+        p2, h2, pt2 = _spawn_serve_proc(
+            spec, tmp, "restart", port=port, journal=j_kill)
+        respawned["proc"] = p2
+
+    import threading
+
+    watcher = threading.Thread(target=respawn_when_dead, daemon=True)
+    watcher.start()
+    try:
+        try:
+            kill_results, kill_wall = drive(host, port, retries=12)
+        finally:
+            watcher.join(timeout=client_timeout)
+            proc2 = respawned.get("proc")
+        if proc2 is None:
+            raise RuntimeError("restart server never came up")
+        journal_replayed = scrape(
+            host, port, r"^llm_serve_journal_replayed_total (\S+)")
+        journal_resumed = scrape(
+            host, port, r"^llm_serve_journal_resumed_total (\S+)")
+        proc2.send_signal(_signal.SIGTERM)
+        proc2.wait(timeout=90)
+    finally:
+        # never leak a warm model server past the child, whatever
+        # failed above (proc_kill not firing, client timeouts, ...)
+        for p in (proc1, respawned.get("proc")):
+            if p is not None and p.poll() is None:
+                p.kill()
+                p.wait(timeout=30)
+    _phase(name, "kill_done", t0, restarts=1)
+
+    kill_parity = [r["token_ids"] for r in kill_results] == plain_tokens
+    resumed = [r for r in kill_results if r.get("resumed")]
+    resume_lat = sorted(r["resume_latency_s"] for r in resumed
+                        if r.get("resume_latency_s"))
+    live, _, epoch = scan_journal(j_kill)
+    plain_stats = leg_stats(plain_results, plain_wall)
+    jr_stats = leg_stats(jr_results, jr_wall)
+    overhead_tok_s = round(
+        plain_stats["client_tok_s"] - jr_stats["client_tok_s"], 1)
+    # generous: this guards a broken hot path (fsync on the tick
+    # thread), not scheduler jitter on a loaded host
+    overhead_ok = (
+        jr_stats["client_tok_s"] >= 0.5 * plain_stats["client_tok_s"]
+    )
+    n = spec["requests"]
+    return {
+        "config": name,
+        "ok": (plain_stats["completed"] == n
+               and jr_stats["completed"] == n
+               and len([r for r in kill_results if r["status"] == 200]) == n
+               and journaled_parity and kill_parity
+               and bool(resumed) and overhead_ok
+               and proc1.returncode == -_signal.SIGKILL
+               and live == {}),
+        "requests": n,
+        "rate_rps": spec["rate"],
+        "kill_tick": spec["kill_tick"],
+        # journal overhead (the journaled-vs-plain pair)
+        "token_parity_journaled_vs_plain": journaled_parity,
+        "client_tok_s_plain": plain_stats["client_tok_s"],
+        "client_tok_s_journaled": jr_stats["client_tok_s"],
+        "journal_overhead_tok_s": overhead_tok_s,
+        "journal_overhead_ok": overhead_ok,
+        "journal_fsync_p99_s": fsync_p99,
+        "journal_records": records,
+        "ttft_s_p99_plain": plain_stats["ttft_s_p99"],
+        "ttft_s_p99_journaled": jr_stats["ttft_s_p99"],
+        # the kill -9 headline
+        "token_parity_across_kill": kill_parity,
+        "streams_resumed": len(resumed),
+        "restart_to_first_resumed_token_s": (
+            round(resume_lat[0], 3) if resume_lat else None),
+        "resume_latency_s_max": (
+            round(resume_lat[-1], 3) if resume_lat else None),
+        "journal_replayed_total": journal_replayed,
+        "journal_resumed_total": journal_resumed,
+        "journal_epoch_final": epoch,
+        "drain_left_unterminated": len(live),
+    }
+
+
 def run_spec_config(name: str) -> dict:
     import numpy as np
 
@@ -1618,6 +1876,7 @@ def run_warm() -> dict:
         and n not in RAGGED_CONFIGS and n not in SERVE_CONFIGS
         and n not in SERVE_HTTP_CONFIGS and n not in SERVE_CHAOS_CONFIGS
         and n not in SERVE_MIXED_CONFIGS and n not in SERVE_SHARDED_CONFIGS
+        and n not in SERVE_RESTART_CONFIGS
     ]
     for name in warmable[:warm_limit]:
         spec = {**DECODE_CONFIGS, **PREFILL_CONFIGS}[name]
@@ -1962,6 +2221,8 @@ def child_main(mode: str) -> None:
         out = run_serve_http_config(mode)
     elif mode in SERVE_CHAOS_CONFIGS:
         out = run_serve_chaos_config(mode)
+    elif mode in SERVE_RESTART_CONFIGS:
+        out = run_serve_restart_config(mode)
     elif mode in SERVE_SHARDED_CONFIGS:
         out = run_serve_sharded_config(mode)
     else:
@@ -2226,6 +2487,7 @@ def main() -> None:
             **RAGGED_CONFIGS, **SERVE_CONFIGS, **SERVE_MIXED_CONFIGS,
             **SERVE_HTTP_CONFIGS,
             **SERVE_CHAOS_CONFIGS, **SERVE_SHARDED_CONFIGS,
+            **SERVE_RESTART_CONFIGS,
         }.get(name, {}).get("env")
         res = _spawn(name, budget, env=spec_env)
         detail[name] = res

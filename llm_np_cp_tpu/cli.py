@@ -296,6 +296,22 @@ def build_http_serve_parser(default_model: str) -> argparse.ArgumentParser:
                    help="the sliding window (seconds) --max-restarts "
                    "counts engine deaths in; a crash LOOP exhausts the "
                    "budget, a blip a day does not")
+    p.add_argument("--journal", default=None, metavar="PATH",
+                   help="durable request journal (serve/journal.py): "
+                   "admissions, per-tick delivery watermarks, and "
+                   "terminals are CRC-framed and fsync'd to PATH off "
+                   "the tick thread; on start, unterminated requests "
+                   "found in PATH are replayed token-identically "
+                   "(teacher-forced) and clients resume dropped SSE "
+                   "streams via Last-Event-ID — so a kill -9 or rolling "
+                   "restart loses no stream.  With --replicas N each "
+                   "replica journals to PATH.<i>.  Default: no journal "
+                   "(hooks are zero-overhead no-ops)")
+    p.add_argument("--journal-compact-bytes", type=int,
+                   default=4 << 20, metavar="N",
+                   help="rewrite the journal as a live-set snapshot "
+                   "whenever N appended bytes accumulate (bounds file "
+                   "growth; replay-equivalent by construction)")
     p.add_argument("--port-file", default=None, metavar="PATH",
                    help="write 'host port' to PATH once listening "
                    "(readiness for scripts and tests)")
@@ -404,7 +420,7 @@ def _build_serve_engine(args, params, config, *, prog: str,
                         tokenizer=None, max_queue: int | None = None,
                         fault_injector=None, mesh_plan=None,
                         mesh_devices=None, shared_tracer=None,
-                        quiet=False):
+                        journal=None, quiet=False):
     """The shared engine build for both serve subcommands: validate the
     pool flags, resolve --attn-impl against the Mosaic probe (an EXPLICIT
     paged request must fail with an actionable message when the kernel
@@ -495,6 +511,7 @@ def _build_serve_engine(args, params, config, *, prog: str,
         tick_token_budget=getattr(args, "tick_token_budget", 0) or None,
         mesh_plan=mesh_plan,
         mesh_devices=mesh_devices,
+        journal=journal,
     )
     if quiet:
         return engine, num_blocks
@@ -653,18 +670,38 @@ def _run_http_serve(argv: list[str], default_model: str) -> str:
         )
     plan, dev_slices = _resolve_serve_mesh(args, "serve")
     injector = _chaos_injector(args)
+    # per-replica durable journal segments, opened (and replayed for
+    # unterminated requests) BEFORE the model load is visible to
+    # clients; a malformed path fails fast here
+    journals: list = [None] * args.replicas
+    if args.journal:
+        from llm_np_cp_tpu.serve.journal import RequestJournal
+
+        paths = (
+            [args.journal] if args.replicas == 1
+            else [f"{args.journal}.{i}" for i in range(args.replicas)]
+        )
+        journals = [
+            RequestJournal(p, fault_injector=injector,
+                           compact_bytes=args.journal_compact_bytes)
+            for p in paths
+        ]
+        replays = [j.stats()["replayed"] for j in journals]
+        print(f"[serve] journal ACTIVE: {args.journal} "
+              f"(epoch {journals[0].epoch}, "
+              f"{sum(replays)} unterminated to replay)")
     tok, params, config = _load(args)
     engine, num_blocks = _build_serve_engine(
         args, params, config, prog="serve", tokenizer=tok,
         max_queue=args.max_queue or None, fault_injector=injector,
-        mesh_plan=plan, mesh_devices=dev_slices[0],
+        mesh_plan=plan, mesh_devices=dev_slices[0], journal=journals[0],
     )
     engines = [engine] + [
         _build_serve_engine(
             args, params, config, prog="serve", tokenizer=tok,
             max_queue=args.max_queue or None, fault_injector=injector,
             mesh_plan=plan, mesh_devices=dev_slices[i],
-            shared_tracer=engine.tracer, quiet=True,
+            shared_tracer=engine.tracer, journal=journals[i], quiet=True,
         )[0]
         for i in range(1, args.replicas)
     ]
@@ -701,7 +738,8 @@ def _run_http_serve(argv: list[str], default_model: str) -> str:
         f"attn={engine.decode_attn_impl}, topo={topo}, "
         f"prefix_cache={'on' if args.prefix_cache else 'off'}, "
         f"max_queue={args.max_queue or 'unbounded'}, "
-        f"supervision={'off' if not args.max_restarts else f'{args.max_restarts} restarts'}"
+        f"supervision={'off' if not args.max_restarts else f'{args.max_restarts} restarts'}, "
+        f"journal={'on' if args.journal else 'off'}"
     )
     print(banner)
 

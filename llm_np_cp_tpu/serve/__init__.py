@@ -38,6 +38,12 @@ Modules:
   slices as Chrome/Perfetto trace-event JSON (``TraceRecorder``);
   zero-overhead is-None hooks when off, ring-buffered for the
   ``GET /debug/trace`` endpoint, dumped via ``--trace-out``.
+- ``journal``     — durable request journal (``RequestJournal``):
+  admissions, per-tick delivery watermarks, and terminals CRC-framed
+  and fsync'd off the tick thread; a killed process (``kill -9``, OOM,
+  rolling deploy) replays unterminated requests token-identically on
+  restart, and clients resume dropped SSE streams via
+  ``Last-Event-ID``; zero-overhead is-None hooks when off.
 - ``replica``     — mesh-scale-out: ``ReplicaSet``/``ReplicaRunner``
   run N data-parallel engine replicas (each optionally TP-sharded via
   ``ServeEngine(mesh_plan=...)`` on its own mesh slice) behind a
@@ -58,6 +64,7 @@ from llm_np_cp_tpu.serve.engine import (
     pool_geometry,
     worst_case_slots,
 )
+from llm_np_cp_tpu.serve.journal import RequestJournal, scan_journal
 from llm_np_cp_tpu.serve.metrics import ServeMetrics
 from llm_np_cp_tpu.serve.prefix_cache import PrefixCache, prefix_block_keys
 from llm_np_cp_tpu.serve.replica import (
@@ -85,6 +92,7 @@ __all__ = [
     "ReplicaRunner",
     "ReplicaSet",
     "Request",
+    "RequestJournal",
     "RequestState",
     "Scheduler",
     "ServeEngine",
@@ -93,5 +101,6 @@ __all__ = [
     "poisson_trace",
     "pool_geometry",
     "prefix_block_keys",
+    "scan_journal",
     "worst_case_slots",
 ]

@@ -201,6 +201,7 @@ class ServeEngine:
         tick_token_budget: int | None = None,
         mesh_plan: Any = None,
         mesh_devices: list | None = None,
+        journal: Any = None,
     ) -> None:
         if decode_attn_impl not in ("xla", "flash_decode", "paged"):
             raise ValueError(
@@ -334,6 +335,11 @@ class ServeEngine:
         # hook is a single is-None check, same discipline as faults
         # (pinned by tools/compile_counter.assert_tracing_hooks_guarded)
         self.tracer = tracer
+        # durable request journal (serve/journal.py): admissions,
+        # per-tick delivery watermarks, and terminals go to an fsync'd
+        # file a restarted PROCESS replays through recover(); same
+        # is-None zero-overhead discipline as faults/tracer
+        self.journal = journal
         # reason string once the paged decode step faulted at dispatch
         # and the engine fell back to the gather impl (None = healthy)
         self.decode_degraded: str | None = None
@@ -1165,6 +1171,11 @@ class ServeEngine:
             if _recovered:
                 self.tracer.request_instant(req.req_id, "recovery-replay")
         self._requests[req.req_id] = req
+        if self.journal is not None and not _recovered:
+            # recovered resubmits are re-journaled from recover() AFTER
+            # their teacher-forced tokens are seeded, so a second crash
+            # replays from the latest full state
+            self.journal.admit(req, now=self.clock())
         if self.tokenizer is not None:
             self._detok[req.req_id] = IncrementalDetok(self.tokenizer)
         return req
@@ -1221,6 +1232,8 @@ class ServeEngine:
         if deadline_at is not None:
             req.deadline = deadline_at
         req.generated = [int(t) for t in generated]
+        if self.journal is not None:
+            self.journal.admit(req, now=self.clock())
         detok = self._detok.get(req.req_id)
         if detok is not None:
             # advance the detokenizer over the replayed tokens so the
@@ -1256,6 +1269,8 @@ class ServeEngine:
         )
         req.generated = [int(t) for t in generated]
         req.finish_reason = reason
+        if self.journal is not None:
+            self.journal.terminal(request_id, reason)
         if reason == "aborted":
             self.metrics.on_abort(req)
         else:
@@ -1301,6 +1316,7 @@ class ServeEngine:
             tick_token_budget=self.tick_token_budget or None,
             mesh_plan=self.mesh_plan,
             mesh_devices=self._mesh_devices,
+            journal=self.journal,
         )
         eng.metrics = self.metrics
         eng.decode_degraded = self.decode_degraded
@@ -1367,6 +1383,13 @@ class ServeEngine:
             self._requests.pop(req.req_id, None)
             self._flush_detok(req)
             self.metrics.on_finish(req)
+            if self.journal is not None:
+                # flush the final delivery delta (the finishing tick's
+                # token would otherwise be missed — the request leaves
+                # the live set before the tick's watermark), then mark
+                # terminal so the replay set stays exact
+                self.journal.end_tick((req,))
+                self.journal.terminal(req.req_id, req.finish_reason)
             if self.tracer is not None:
                 self.tracer.request_end(req.req_id, req.finish_reason)
             self._emit_event(req, req.finish_reason)
@@ -1396,6 +1419,9 @@ class ServeEngine:
         req.finish_time = self.clock()
         self._flush_detok(req)
         self.metrics.on_abort(req)
+        if self.journal is not None:
+            self.journal.end_tick((req,))
+            self.journal.terminal(req.req_id, "aborted")
         if self.tracer is not None:
             self.tracer.request_end(req.req_id, "aborted")
         self._emit_event(req, "aborted")
@@ -1598,6 +1624,11 @@ class ServeEngine:
                 self._emit(r, int(nxt_host[r.slot]))
                 self._maybe_finish(r)
 
+        if self.journal is not None:
+            # ONE delivery-watermark record for the whole tick (rows
+            # for every live request whose count advanced) — batched
+            # per tick, never per token
+            self.journal.end_tick(self._requests.values())
         self.metrics.on_tick(
             queue_depth=self.scheduler.queue_depth,
             occupancy=self.pool.occupancy,
@@ -1802,6 +1833,9 @@ class ServeEngine:
                 self._emit(r, int(nxt_host[r.slot]))
                 self._maybe_finish(r)
 
+        if self.journal is not None:
+            # same per-tick watermark batching as the split tick
+            self.journal.end_tick(self._requests.values())
         active = n_decode_tok + len(prefill_segs)
         self.metrics.on_tick(
             queue_depth=self.scheduler.queue_depth,
@@ -2057,13 +2091,18 @@ class ServeEngine:
         # not fire here, where no supervisor is watching yet.  The tracer
         # is suspended with it — warmup's dummy request is not part of
         # any measured timeline, like the metrics reset below.
+        # the journal is suspended with them: warmup's dummy request is
+        # compile-only and must not leave admission records a restart
+        # would try to replay
         faults, self.faults = self.faults, None
         tracer, self.tracer = self.tracer, None
+        journal, self.journal = self.journal, None
         try:
             self._warmup_body(prompt_lens, max_new_tokens)
         finally:
             self.faults = faults
             self.tracer = tracer
+            self.journal = journal
 
     def _warmup_body(self, prompt_lens: list[int],
                      max_new_tokens: int) -> None:

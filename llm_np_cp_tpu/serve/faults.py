@@ -34,6 +34,14 @@ Sites (each named where it is threaded in):
 - ``http_429``    — reject a ``/v1/completions`` with 429 + Retry-After
                     ``ARG`` (client retry/backoff food)
 - ``http_reset``  — hard-abort the client socket mid-SSE-stream
+- ``proc_kill``   — SIGKILL the WHOLE PROCESS from the tick loop (hit
+                    once per busy tick, so ``proc_kill@N`` dies after N
+                    ticks) — the deterministic ``kill -9`` the durable
+                    request journal's restart/resume path is tested
+                    against (serve/journal.py)
+- ``journal_write`` / ``journal_fsync`` — fail the journal writer
+                    thread's file write / fsync (durability degradation:
+                    the batch is dropped and counted, serving continues)
 
 No-op by default: nothing constructs an injector unless a chaos spec is
 given (``--chaos-spec`` / ``LLMTPU_CHAOS_SPEC``), and every injection
@@ -56,6 +64,9 @@ SITES = (
     "ckpt_read",
     "http_429",
     "http_reset",
+    "proc_kill",
+    "journal_write",
+    "journal_fsync",
 )
 
 

@@ -148,6 +148,67 @@ def parse_completion_request(
     )
 
 
+def parse_completion_rid(raw: Any) -> int:
+    """``"cmpl-123"`` (what responses echo) or a bare int → 123."""
+    if isinstance(raw, int) and not isinstance(raw, bool) and raw >= 0:
+        return raw
+    if isinstance(raw, str) and raw.startswith("cmpl-"):
+        tail = raw[len("cmpl-"):]
+        if tail.isdigit():
+            return int(tail)
+    raise HTTPError(
+        400, f"'request_id' must be a completion id like 'cmpl-7' "
+        f"(or its bare integer), got {raw!r}")
+
+
+def parse_last_event_id(raw: Any) -> int:
+    """``Last-Event-ID`` header / ``last_event_id`` field → delivered-
+    token count (0 = replay from the start)."""
+    if raw is None:
+        return 0
+    try:
+        n = int(raw)
+    except (TypeError, ValueError):
+        n = -1
+    if n < 0 or isinstance(raw, bool):
+        raise HTTPError(
+            400, f"Last-Event-ID must be a delivered-token count >= 0, "
+            f"got {raw!r}")
+    return n
+
+
+def parse_resume_request(
+    body: bytes, headers: dict[str, str], *, model_id: str,
+) -> tuple[int, int, str] | None:
+    """Stream-resume detection for ``POST /v1/completions``: a body
+    naming a ``request_id`` is a resume of that dropped SSE stream →
+    ``(rid, last delivered-token index, echo model)``; None means a
+    fresh completion (the normal parser takes over).  The resume index
+    comes from the ``Last-Event-ID`` header (the SSE reconnect
+    convention — event ids on token frames are delivered-token indices)
+    or a ``last_event_id`` body field."""
+    try:
+        obj = json.loads(body)
+    except (json.JSONDecodeError, UnicodeDecodeError):
+        return None  # let the normal parser raise its 400
+    if not isinstance(obj, dict) or "request_id" not in obj:
+        return None
+    rid = parse_completion_rid(obj["request_id"])
+    model = obj.get("model", model_id)
+    if not isinstance(model, str) or model != model_id:
+        raise HTTPError(
+            404, f"model {model!r} not found; this server serves "
+            f"{model_id!r}", code="model_not_found",
+        )
+    if obj.get("stream", True) is not True:
+        raise HTTPError(400, "resume replays an SSE stream; "
+                             "'stream' must be true")
+    raw = headers.get("last-event-id")
+    if raw is None:
+        raw = obj.get("last_event_id")
+    return rid, parse_last_event_id(raw), model
+
+
 # ----------------------------------------------------------------------
 # Response builders
 # ----------------------------------------------------------------------

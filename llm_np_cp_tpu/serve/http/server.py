@@ -67,12 +67,23 @@ from llm_np_cp_tpu.serve.http.protocol import (
     completion_payload,
     error_body,
     parse_completion_request,
+    parse_completion_rid,
+    parse_last_event_id,
+    parse_resume_request,
 )
 from llm_np_cp_tpu.serve.http.sse import DONE_SENTINEL, sse_event
 from llm_np_cp_tpu.serve.metrics import ServeMetrics
 from llm_np_cp_tpu.serve.scheduler import QueueFull
 
 TERMINAL_EVENTS = ("stop", "length", "aborted")
+
+
+class _ResumeEcho:
+    """The one payload field ``_stream_response`` reads, for resumed
+    streams (which carry no CompletionPayload)."""
+
+    def __init__(self, echo_model: str) -> None:
+        self.echo_model = echo_model
 _REASONS = {
     200: "OK", 400: "Bad Request", 404: "Not Found",
     405: "Method Not Allowed", 408: "Request Timeout",
@@ -144,7 +155,6 @@ class EngineRunner:
         # once (engine thread, on the terminal event / reject)
         self._live: dict[int, tuple[asyncio.AbstractEventLoop,
                                     asyncio.Queue]] = {}
-        self._rid = itertools.count(getattr(engine, "_next_id", 0))
         # set when the tick thread dies terminally (supervision off or
         # restart budget exhausted): the server turns /healthz unhealthy
         # and rejects new work instead of silently wedging every stream
@@ -175,10 +185,172 @@ class EngineRunner:
         # REBUILT engine is still caught (just a little later) instead
         # of recovery muting the watchdog outright
         self._backoff_delay = 0.0
-        # replay ledger: rid → {prompt, max_tokens, seed, deadline_s,
-        # tokens delivered so far}, insertion-ordered (original FIFO) —
-        # everything a restart needs to teacher-force the stream back
+        # replay ledger: rid → {prompt, max_tokens, seed, deadline_at,
+        # tokens (+ text deltas) delivered so far}, insertion-ordered
+        # (original FIFO) — everything a restart needs to teacher-force
+        # the stream back, and what a Last-Event-ID resume replays
         self._inflight: dict[int, dict] = {}
+        # terminal output of DETACHED streams (finished while no client
+        # was attached — journal-recovered requests above all), kept so
+        # a late resume still gets its suffix + finish; bounded LRU
+        self._resumable: dict[int, dict] = {}
+        # fleet hook (serve/replica.ReplicaRunner): called from
+        # _terminal_crash with the in-flight replay list; returns the
+        # rids a live peer adopted (those streams are NOT abort-flushed)
+        self.on_terminal_crash = None
+        # durable request journal (serve/journal.py): replay the
+        # unterminated requests a dead PROCESS left behind — runs here
+        # in the constructor, before any thread exists, so engine access
+        # stays single-threaded
+        self.journal = getattr(engine, "journal", None)
+        self.journal_replayed = 0
+        self.journal_resumed = 0
+        if self.journal is not None:
+            self._replay_journal()
+        # past every replayed rid, PARKED ones included: a request
+        # recovered terminal (finish_recovered) never touches the
+        # engine's _next_id, and re-issuing its rid would let a fresh
+        # request shadow the parked stream a client is about to resume
+        self._rid = itertools.count(max(
+            getattr(engine, "_next_id", 0),
+            max(self._resumable, default=-1) + 1,
+        ))
+
+    # -- journal replay + stream resume --------------------------------
+    def _replay_journal(self) -> None:
+        """Teacher-force every unterminated journaled request back into
+        the engine (the ``kill -9`` analogue of the supervised restart's
+        in-process replay).  Delivered tokens are forced, the REMAINING
+        deadline budget is resumed (the journal stores deadlines as wall
+        time; expired budgets get swept on the first tick), and the
+        ledger is rebuilt so a client can re-attach via Last-Event-ID."""
+        if self.journal is None:
+            return
+        now_wall = time.time()
+        clock_now = self.engine.clock()
+        for rec in self.journal.replay():
+            deadline_at = None
+            if rec.get("deadline_wall") is not None:
+                # remaining budget on the NEW engine clock; negative =
+                # expired while the process was down → swept first tick
+                deadline_at = clock_now + (rec["deadline_wall"] - now_wall)
+            self._replay_one(0, dict(
+                rec, deadline_at=deadline_at,
+                deltas=self._replay_deltas(rec["tokens"]),
+            ), require_live=False)
+            self.journal_replayed += 1
+
+    def _replay_deltas(self, tokens: list) -> list:
+        """Per-token text deltas for a journaled token prefix (a fresh
+        detokenizer replayed over the same ids yields the same deltas
+        the original stream emitted) — what a resuming client's replayed
+        suffix carries as text."""
+        tok = getattr(self.engine, "tokenizer", None)
+        if tok is None or not tokens:
+            return [None] * len(tokens)
+        from llm_np_cp_tpu.generate import IncrementalDetok
+
+        detok = IncrementalDetok(tok)
+        return [detok.push(t) for t in tokens]
+
+    def _replay_one(self, gen: int, rec: dict, *,
+                    require_live: bool = True) -> None:
+        """Recover ONE ledger/journal record into ``self.engine`` —
+        the per-request move shared by the supervised restart's replay,
+        the constructor's journal replay, and a fleet peer adopting a
+        dead replica's stream.  ``require_live`` is the supervised-
+        restart discipline (a stream whose client went away while the
+        engine was down is dropped); journal/fleet replays keep
+        detached requests generating for a later resume."""
+        rid = rec["rid"]
+        if require_live and rid not in self._live:
+            # the stream went away while we were down — drop its ledger
+            # entry too, or it would be re-scanned (and leak) on every
+            # future restart
+            with self._sup_lock:
+                if gen == self._gen:
+                    self._inflight.pop(rid, None)
+            return
+        engine = self.engine
+        tokens = rec["tokens"]
+        stops = tuple(getattr(engine, "stop_tokens", ()) or ())
+        done = len(tokens) >= rec["max_tokens"]
+        stopped = bool(tokens) and tokens[-1] in stops
+        if done or stopped:
+            # fully generated pre-crash; only the finish event was
+            # lost — deliver it without re-running anything
+            self._finish_replayed(gen, rec, "stop" if stopped else "length")
+            return
+        cb, on_event = self._bridge(gen)
+        try:
+            engine.recover(
+                rec["prompt"], rec["max_tokens"], request_id=rid,
+                seed=rec["seed"], generated=tokens, callback=cb,
+                on_event=on_event, deadline_at=rec.get("deadline_at"),
+            )
+        except Exception as e:  # noqa: BLE001 — per-request fate
+            # a request the rebuilt pool cannot re-admit fails alone,
+            # not the whole replay
+            self._finish_replayed(gen, rec, "aborted")
+            print(f"[serve] recovery dropped request {rid}: {e}",
+                  file=sys.stderr)
+        else:
+            with self._sup_lock:
+                if gen == self._gen:
+                    self._inflight[rid] = dict(
+                        rec, tokens=list(tokens),
+                        deltas=list(rec.get("deltas") or
+                                    [None] * len(tokens)),
+                    )
+
+    def _finish_replayed(self, gen: int, rec: dict, reason: str) -> None:
+        """Terminal bookkeeping for a replayed request that needs no
+        re-run: deliver the lost finish to an attached stream, or park
+        the full output for a late Last-Event-ID resume."""
+        rid = rec["rid"]
+        with self._sup_lock:
+            if gen != self._gen:
+                return
+            self._inflight.pop(rid, None)
+        tail = self.engine.finish_recovered(
+            rec["prompt"], rec["max_tokens"], request_id=rid,
+            generated=rec["tokens"], reason=reason,
+        )
+        if rid in self._live:
+            self._push(rid, ("finish", reason, tail))
+            self._live.pop(rid, None)
+        else:
+            self._stash_resumable(rid, rec, reason, tail)
+
+    def _stash_resumable(self, rid: int, rec: dict, reason: str,
+                         tail: str | None) -> None:
+        """Park a DETACHED stream's terminal output (bounded LRU): a
+        client resuming after the finish still gets its journaled
+        suffix + finish exactly once."""
+        self._resumable[rid] = {
+            "tokens": list(rec["tokens"]),
+            "deltas": list(rec.get("deltas") or
+                           [None] * len(rec["tokens"])),
+            "reason": reason,
+            "tail": tail,
+        }
+        while len(self._resumable) > 512:
+            self._resumable.pop(next(iter(self._resumable)))
+
+    def resume(self, rid: int, last_idx: int,
+               loop: asyncio.AbstractEventLoop, aq: asyncio.Queue) -> None:
+        """Re-attach a dropped SSE stream: replay delivered tokens from
+        index ``last_idx`` (the client's Last-Event-ID), then continue
+        live.  The attach runs ON the engine thread, atomically between
+        ticks, so the replayed suffix and the live continuation can
+        neither race nor duplicate."""
+        self._cmds.put(("attach", rid, last_idx, loop, aq))
+        if self.crashed:
+            # same crash race answer as submit(): nobody will process
+            # the command (duplicates are harmless — the handler stops
+            # at the first terminal event)
+            aq.put_nowait(("gone",
+                           f"engine tick thread crashed: {self.crashed}"))
 
     # -- event-loop side ----------------------------------------------
     def start(self) -> None:
@@ -198,6 +370,10 @@ class EngineRunner:
             thread.join(timeout=timeout)
         if self._watchdog is not None:
             self._watchdog.join(timeout=1.0)
+        if self.journal is not None:
+            # drain's aborts already journaled their terminals; flush
+            # them so a CLEAN shutdown leaves an empty replay set
+            self.journal.close()
 
     @property
     def inflight(self) -> int:
@@ -260,6 +436,9 @@ class EngineRunner:
                 rec = self._inflight.get(req.req_id)
                 if rec is not None:
                     rec["tokens"].append(int(tok))
+                    deltas = rec.get("deltas")
+                    if deltas is not None:
+                        deltas.append(delta)
             self._push(req.req_id, ("token", int(tok), delta))
 
         def on_event(req: Any, event: str) -> None:
@@ -268,7 +447,16 @@ class EngineRunner:
             with self._sup_lock:
                 if gen != self._gen:
                     return
-                self._inflight.pop(req.req_id, None)
+                rec = self._inflight.pop(req.req_id, None)
+            if req.req_id not in self._live:
+                # DETACHED terminal (a journal-recovered stream whose
+                # client has not re-attached yet): park the output so a
+                # late Last-Event-ID resume still completes
+                if rec is not None:
+                    self._stash_resumable(
+                        req.req_id, rec, event,
+                        req.extra.pop("final_text_delta", None))
+                return
             self._push(req.req_id, (
                 "finish", event,
                 req.extra.pop("final_text_delta", None),
@@ -333,13 +521,79 @@ class EngineRunner:
                     # window per crash
                     "deadline_at": req.deadline,
                     "tokens": [],
+                    # parallel text deltas, so a Last-Event-ID resume
+                    # replays the exact text the stream would have
+                    # carried
+                    "deltas": [],
                 }
                 self._push(rid, ("accepted",))
+        elif kind == "attach":
+            self._exec_attach(cmd)
+        elif kind == "recover":
+            # a peer replica's drained stream (fleet adoption) — the
+            # same teacher-forced move as a restart replay
+            self._replay_one(gen, cmd[1], require_live=False)
         elif kind == "abort":
             self.engine.abort(cmd[1])
         elif kind == "abort_all":
             for rid in list(self._live):
                 self.engine.abort(rid)
+
+    def _exec_attach(self, cmd: tuple) -> None:
+        """Attach a resuming client to a live or parked stream (on the
+        engine thread — atomic with respect to token emission, so the
+        replayed suffix and live continuation cannot interleave out of
+        order).  Event ids are delivered-token indices: the client's
+        Last-Event-ID is the count it HAS, so the replay starts there."""
+        _, rid, last_idx, loop, aq = cmd
+        rec = self._inflight.get(rid)
+        fin = self._resumable.get(rid) if rec is None else None
+        src = rec if rec is not None else fin
+        verdict = None
+        if src is not None and rid in self._live:
+            # already claimed: a duplicate resume (or a guessed id) must
+            # not rebind the live bridge entry — that would hijack the
+            # attached client's stream and strand it without a terminal
+            verdict = ("gone",
+                       f"request {rid} already has an attached stream")
+        elif src is None:
+            verdict = ("gone", f"unknown or expired request id {rid}")
+        elif last_idx > len(src["tokens"]):
+            if rec is not None:
+                # the async-fsync window: the client can legitimately be
+                # AHEAD of the journal (a watermark lost to the kill or
+                # a dropped write batch) while the recovered request is
+                # still regenerating its deterministic stream — tell the
+                # client to retry shortly, not that the stream is gone
+                verdict = ("busy",
+                           f"request {rid} has regenerated "
+                           f"{len(src['tokens'])} of the {last_idx} "
+                           "tokens the client holds; retry shortly")
+            else:
+                verdict = ("gone",
+                           f"Last-Event-ID {last_idx} is past the "
+                           f"{len(src['tokens'])} tokens delivered for "
+                           f"request {rid}")
+        if verdict is not None:
+            try:
+                loop.call_soon_threadsafe(aq.put_nowait, verdict)
+            except RuntimeError:
+                pass
+            return
+        self._live[rid] = (loop, aq)
+        self.journal_resumed += 1
+        self._push(rid, ("accepted",))
+        toks = src["tokens"][last_idx:]
+        deltas = src.get("deltas") or []
+        deltas = deltas[last_idx:]
+        for i, tok in enumerate(toks):
+            self._push(rid, ("token", int(tok),
+                             deltas[i] if i < len(deltas) else None))
+        if fin is not None:
+            # the stream finished while detached: suffix + finish, once
+            self._resumable.pop(rid, None)
+            self._push(rid, ("finish", fin["reason"], fin["tail"]))
+            self._live.pop(rid, None)
 
     # -- supervision ---------------------------------------------------
     def _spawn_thread(self, gen: int, *, delay: float = 0.0,
@@ -404,6 +658,15 @@ class EngineRunner:
                         from llm_np_cp_tpu.serve.faults import FaultInjected
 
                         raise FaultInjected("tick_crash")
+                    if faults.trip("proc_kill") is not None:
+                        # the kill -9 site: no drain, no flush, no
+                        # atexit — exactly what the request journal's
+                        # restart/resume path must survive
+                        import os
+
+                        print("[chaos] proc_kill: SIGKILL self",
+                              file=sys.stderr, flush=True)
+                        os.kill(os.getpid(), signal.SIGKILL)
                 engine.step()
                 # terminal requests already delivered their events
                 # through the bridge — dropping them here keeps a
@@ -448,9 +711,12 @@ class EngineRunner:
         # it (engine internals have no gen guard — only the bridge does)
         # and double-count with the replay below.  The tracer is muted
         # the same way: a zombie tick must not interleave stale spans
-        # into the timeline the rebuilt engine now owns.
+        # into the timeline the rebuilt engine now owns — and so is the
+        # journal: a zombie's stale watermarks must not corrupt the
+        # delivered-count marks the rebuilt engine now advances.
         old.metrics = ServeMetrics(clock=old.clock)
         old.tracer = None
+        old.journal = None
         with self._sup_lock:
             if gen != self._gen:
                 # superseded DURING the rebuild (it wedged long enough
@@ -458,53 +724,11 @@ class EngineRunner:
                 # owns self.engine) — walk away without touching anything
                 return
             self.engine = engine
-        stops = tuple(getattr(engine, "stop_tokens", ()) or ())
-
-        def finish_out_of_band(rec: dict, reason: str) -> None:
-            with self._sup_lock:
-                if gen != self._gen:
-                    return
-                self._inflight.pop(rec["rid"], None)
-            tail = engine.finish_recovered(
-                rec["prompt"], rec["max_tokens"], request_id=rec["rid"],
-                generated=rec["tokens"], reason=reason,
-            )
-            self._push(rec["rid"], ("finish", reason, tail))
-            self._live.pop(rec["rid"], None)
 
         for rec in replay:
             if gen != self._gen:
                 return  # superseded mid-replay — the newer thread redoes it
-            rid = rec["rid"]
-            if rid not in self._live:
-                # the stream went away while we were down — drop its
-                # ledger entry too, or it would be re-scanned (and leak)
-                # on every future restart
-                with self._sup_lock:
-                    if gen == self._gen:
-                        self._inflight.pop(rid, None)
-                continue
-            tokens = rec["tokens"]
-            done = len(tokens) >= rec["max_tokens"]
-            stopped = bool(tokens) and tokens[-1] in stops
-            if done or stopped:
-                # fully generated pre-crash; only the finish event was
-                # lost — deliver it without re-running anything
-                finish_out_of_band(rec, "stop" if stopped else "length")
-                continue
-            cb, on_event = self._bridge(gen)
-            try:
-                engine.recover(
-                    rec["prompt"], rec["max_tokens"], request_id=rid,
-                    seed=rec["seed"], generated=tokens, callback=cb,
-                    on_event=on_event, deadline_at=rec["deadline_at"],
-                )
-            except Exception as e:  # noqa: BLE001 — per-request fate
-                # a request the REBUILT pool cannot re-admit (should not
-                # happen — same geometry) fails alone, not the restart
-                finish_out_of_band(rec, "aborted")
-                print(f"[serve] recovery dropped request {rid}: {e}",
-                      file=sys.stderr)
+            self._replay_one(gen, rec)
             if gen == self._gen:
                 self._beat = time.monotonic()
         if tr is not None:
@@ -574,9 +798,31 @@ class EngineRunner:
         # burning the device for already-flushed streams
         self._gen += 1
         self.recovering = False
+        # fleet drain (serve/replica.ReplicaRunner): a live peer can
+        # ADOPT this runner's unterminated streams — those clients see a
+        # pause and then the peer's token-identical continuation instead
+        # of an abort
+        adopted: set[int] = set()
+        hook = self.on_terminal_crash
+        if hook is not None and self._inflight:
+            replay = [dict(rec, tokens=list(rec["tokens"]),
+                           deltas=list(rec.get("deltas") or ()))
+                      for rec in self._inflight.values()]
+            adopted = hook(replay)
         for rid in list(self._live):
+            if rid in adopted:
+                continue  # a peer now owns this stream's bridge entry
             self._push(rid, ("finish", "aborted", None))
             self._live.pop(rid, None)
+        # the flush IS these requests' terminal: journal it (the writer
+        # thread outlives the tick thread), or the next process start
+        # would replay streams whose clients already saw 'aborted' —
+        # generating for nobody and inflating journal_replayed_total
+        journal = self.journal
+        if journal is not None:
+            for rid in self._inflight:
+                if rid not in adopted:
+                    journal.terminal(rid, "aborted")
         self._inflight.clear()
 
     def _watch(self) -> None:
@@ -797,7 +1043,25 @@ class HttpServer:
                 await self._respond_error(writer, HTTPError(
                     405, "use POST for /v1/completions"))
             else:
-                await self._completions(reader, writer, body, t_accept)
+                await self._completions(reader, writer, body, headers,
+                                        t_accept)
+        elif path.startswith("/v1/completions/"):
+            # stream resume by id: GET /v1/completions/cmpl-N with a
+            # Last-Event-ID header replays the journaled suffix over
+            # SSE and continues live (serve/journal.py)
+            if method != "GET":
+                await self._respond_error(writer, HTTPError(
+                    405, "use GET to resume a completion stream"))
+                return
+            try:
+                rid = parse_completion_rid(path.rsplit("/", 1)[1])
+                last_idx = parse_last_event_id(
+                    headers.get("last-event-id"))
+            except HTTPError as e:
+                await self._respond_error(writer, e)
+                return
+            await self._resume(reader, writer, rid, last_idx,
+                               self.model_id, t_accept)
         else:
             await self._respond_error(writer, HTTPError(
                 404, f"no route for {method} {path}"))
@@ -829,12 +1093,32 @@ class HttpServer:
         return method, path, headers, body
 
     def _render_metrics(self) -> str:
+        # durable-journal observables (zero when journaling is off):
+        # what the restart/resume acceptance checks and an operator's
+        # alerting read off the scrape
+        journal_gauges = {
+            "journal_replayed_total": float(
+                getattr(self.runner, "journal_replayed", 0)),
+            "journal_resumed_total": float(
+                getattr(self.runner, "journal_resumed", 0)),
+        }
+        journal = getattr(self.runner, "journal", None)
+        if journal is not None:
+            jstats = journal.stats()
+            journal_gauges.update({
+                "journal_records_total": float(jstats["records"]),
+                "journal_fsync_p99_s": jstats["fsync_p99_s"],
+                "journal_write_errors_total": float(
+                    jstats["write_errors"] + jstats["fsync_errors"]),
+                "journal_epoch": float(jstats["epoch"]),
+            })
         render = getattr(self.runner, "render_metrics", None)
         if render is not None:
             # replica fleet: per-replica series with replica labels +
             # router counters (serve/replica.ReplicaRunner)
             return render(extra_gauges={
                 "draining": 1.0 if self.draining else 0.0,
+                **journal_gauges,
             })
         # the runner's engine, NOT self.engine: a supervised restart
         # rebinds it, and a scrape must see the live pool/scheduler
@@ -862,12 +1146,14 @@ class HttpServer:
             "decode_impl_degraded": (
                 1.0 if engine.decode_degraded else 0.0
             ),
+            **journal_gauges,
         })
 
     # ------------------------------------------------------------------
     async def _completions(self, reader: asyncio.StreamReader,
                            writer: asyncio.StreamWriter,
-                           body: bytes, t_accept: float = -1.0) -> None:
+                           body: bytes, headers: dict[str, str],
+                           t_accept: float = -1.0) -> None:
         if self.draining or self.runner.crashed:
             msg = ("engine tick thread crashed: " + self.runner.crashed
                    if self.runner.crashed
@@ -890,6 +1176,16 @@ class HttpServer:
                 ))
                 return
         try:
+            resume = parse_resume_request(
+                body, headers, model_id=self.model_id)
+            if resume is not None:
+                # re-POST with the original request id: the resume
+                # protocol's POST spelling (GET /v1/completions/<id> is
+                # the other)
+                rid, last_idx, echo_model = resume
+                await self._resume(reader, writer, rid, last_idx,
+                                   echo_model, t_accept)
+                return
             payload = parse_completion_request(
                 body, model_id=self.model_id, tokenizer=self.tokenizer,
                 default_max_tokens=self.default_max_tokens,
@@ -962,6 +1258,67 @@ class HttpServer:
             with contextlib.suppress(asyncio.CancelledError):
                 await monitor
 
+    async def _resume(self, reader: asyncio.StreamReader,
+                      writer: asyncio.StreamWriter, rid: int,
+                      last_idx: int, echo_model: str,
+                      t_accept: float = -1.0) -> None:
+        """Re-attach a dropped SSE stream (serve/journal.py resume
+        protocol): replay the delivered-token suffix from the client's
+        Last-Event-ID, then continue live.  404 when the id is unknown
+        or already claimed — the client falls back to a fresh POST."""
+        if self.draining or self.runner.crashed:
+            await self._respond_error(writer, HTTPError(
+                503, "server is draining for shutdown"
+                if self.draining else
+                "engine tick thread crashed: " + str(self.runner.crashed),
+                etype="server_error", headers=(("Retry-After", "1"),),
+            ))
+            return
+        loop = asyncio.get_running_loop()
+        aq: asyncio.Queue = asyncio.Queue()
+        tracer = self.tracer
+        if tracer is not None:
+            tracer.async_begin(rid, "http",
+                               ts_us=t_accept if t_accept >= 0.0 else None,
+                               args={"resume": True,
+                                     "last_event_id": last_idx})
+        try:
+            self.runner.resume(rid, last_idx, loop, aq)
+            verdict = await aq.get()
+            if verdict[0] == "gone":
+                await self._respond_error(writer, HTTPError(
+                    404, verdict[1], code="unknown_completion"))
+                return
+            if verdict[0] == "busy":
+                # the client is ahead of the journaled prefix while the
+                # recovered stream regenerates — retryable, not terminal
+                await self._respond_error(writer, HTTPError(
+                    503, verdict[1], etype="server_error",
+                    headers=(("Retry-After", "1"),),
+                ))
+                return
+            if verdict[0] == "finish":
+                await self._respond_error(writer, HTTPError(
+                    503, "engine tick thread crashed before the resume "
+                    "was attached", etype="server_error",
+                ))
+                return
+            created = int(time.time())
+            payload = _ResumeEcho(echo_model)
+            monitor = asyncio.ensure_future(
+                self._watch_disconnect(reader))
+            try:
+                await self._stream_response(
+                    writer, aq, monitor, rid, payload, created,
+                    start_idx=last_idx)
+            finally:
+                monitor.cancel()
+                with contextlib.suppress(asyncio.CancelledError):
+                    await monitor
+        finally:
+            if tracer is not None:
+                tracer.async_end(rid, "http")
+
     @staticmethod
     async def _watch_disconnect(reader: asyncio.StreamReader) -> None:
         while True:
@@ -984,7 +1341,11 @@ class HttpServer:
         return None
 
     async def _stream_response(self, writer, aq, monitor, rid,
-                               payload, created) -> None:
+                               payload, created, start_idx: int = 0) -> None:
+        # delivered-token index, carried as the SSE event id on every
+        # token frame: a client that reconnects with Last-Event-ID = the
+        # last id it saw gets exactly the tokens it is missing
+        idx = start_idx
         try:
             writer.write(
                 b"HTTP/1.1 200 OK\r\n"
@@ -1005,10 +1366,11 @@ class HttpServer:
                 return
             if ev[0] == "token":
                 _, tok, delta = ev
+                idx += 1
                 frame = sse_event(chunk_payload(
                     rid, payload.echo_model, created,
                     text=delta or "", token_id=tok, finish_reason=None,
-                ))
+                ), event_id=idx)
             else:  # ("finish", reason, tail)
                 _, reason, tail = ev
                 frame = sse_event(chunk_payload(

@@ -15,19 +15,29 @@ from typing import Any, AsyncIterator
 DONE_SENTINEL = b"data: [DONE]\n\n"
 
 
-def sse_event(payload: dict[str, Any]) -> bytes:
-    """One ``data:`` frame.  Payloads are single-line JSON, so the
-    multi-line ``data:`` continuation rule never applies."""
-    return b"data: " + json.dumps(payload, separators=(",", ":")).encode() \
-        + b"\n\n"
+def sse_event(payload: dict[str, Any], *,
+              event_id: int | None = None) -> bytes:
+    """One ``data:`` frame, optionally carrying an ``id:`` line.  Token
+    frames use the delivered-token index as the event id — what a
+    reconnecting client sends back as ``Last-Event-ID`` to resume the
+    stream after a server restart (serve/journal.py).  Payloads are
+    single-line JSON, so the multi-line ``data:`` continuation rule
+    never applies."""
+    head = f"id: {event_id}\n".encode() if event_id is not None else b""
+    return head + b"data: " \
+        + json.dumps(payload, separators=(",", ":")).encode() + b"\n\n"
 
 
 def parse_sse_line(line: bytes) -> dict[str, Any] | None:
     """Decode one stripped SSE line → payload dict, None for the [DONE]
-    sentinel / blank separators / comments.  Raises ValueError on a
-    ``data:`` line that is not valid JSON (a framing bug, not traffic)."""
+    sentinel / blank separators / comments / non-data fields (``id:``,
+    ``event:``, ``retry:``).  Raises ValueError on a ``data:`` line
+    that is not valid JSON (a framing bug, not traffic)."""
     line = line.strip()
     if not line or line.startswith(b":"):
+        return None
+    if (line.startswith(b"id:") or line.startswith(b"event:")
+            or line.startswith(b"retry:")):
         return None
     if not line.startswith(b"data:"):
         raise ValueError(f"not an SSE data line: {line!r}")

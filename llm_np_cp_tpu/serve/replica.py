@@ -400,6 +400,8 @@ class ReplicaRunner:
                  restart_backoff_s: float = 0.5,
                  restart_window_s: float = 300.0,
                  spill_queue_depth: int | None = 4) -> None:
+        from functools import partial
+
         from llm_np_cp_tpu.serve.http.server import EngineRunner
 
         _check_homogeneous(engines)
@@ -412,6 +414,11 @@ class ReplicaRunner:
             )
             for e in engines
         ]
+        for i, runner in enumerate(self.replicas):
+            # fleet drain: a replica going terminally dark hands its
+            # unterminated streams to the peers the router re-homes its
+            # prefixes to, instead of abort-flushing them
+            runner.on_terminal_crash = partial(self._drain_dead, i)
         e0 = engines[0]
         self.router = PrefixRouter(
             len(engines), block_size=e0.block_size,
@@ -420,9 +427,18 @@ class ReplicaRunner:
         )
         self.faults = self.replicas[0].faults
         self._owner: dict[int, int] = {}
-        self._rid = itertools.count(
-            max(getattr(e, "_next_id", 0) for e in engines)
-        )
+        self._rid = itertools.count(max(
+            max(getattr(e, "_next_id", 0) for e in engines),
+            # journal-replayed rids must never be re-issued — PARKED
+            # (finished-while-detached) ones included: finish_recovered
+            # never bumps the engine's _next_id, and a fresh request
+            # reusing the rid would shadow the stream its client is
+            # about to resume (the EngineRunner.__init__ defense,
+            # fleet-wide)
+            max((r for runner in self.replicas
+                 for r in (*runner._inflight, *runner._resumable)),
+                default=-1) + 1,
+        ))
         self._dead: set[int] = set()  # replicas whose death was forgotten
 
     # -- the EngineRunner interface ------------------------------------
@@ -454,6 +470,14 @@ class ReplicaRunner:
     @property
     def recovery_latency_s(self) -> list[float]:
         return [v for r in self.replicas for v in r.recovery_latency_s]
+
+    @property
+    def journal_replayed(self) -> int:
+        return sum(r.journal_replayed for r in self.replicas)
+
+    @property
+    def journal_resumed(self) -> int:
+        return sum(r.journal_resumed for r in self.replicas)
 
     @property
     def crashed(self) -> str | None:
@@ -531,6 +555,70 @@ class ReplicaRunner:
         for r in self.replicas:
             r.abort_all()
 
+    def resume(self, rid: int, last_idx: int, loop: Any, aq: Any) -> None:
+        """Route a Last-Event-ID resume to the replica holding the
+        stream.  After a process restart the owner map is empty, so an
+        unknown rid probes each replica's ledger/parked set (the
+        journal segments replayed into their own replicas)."""
+        idx = self._owner.get(rid)
+        if idx is None or self.replicas[idx].crashed:
+            idx = next(
+                (i for i, r in enumerate(self.replicas)
+                 if r.crashed is None
+                 and (rid in r._inflight or rid in r._resumable)),
+                None,
+            )
+        if idx is None:
+            aq.put_nowait(("gone",
+                           f"unknown or expired request id {rid}"))
+            return
+        self._owner[rid] = idx
+        self.replicas[idx].resume(rid, last_idx, loop, aq)
+
+    def _drain_dead(self, dead_idx: int, replay: list[dict]) -> set[int]:
+        """A replica went terminally dark: adopt its unterminated
+        streams onto live peers — each request re-routes through the
+        router AFTER its sticky prefixes are forgotten, so a stream
+        lands on the peer its prefix chain re-homes to, is replayed
+        teacher-forced there (token-identical), and its bridge entry
+        moves so the client never sees more than a pause.  The dead
+        replica's journal gets a ``drained`` terminal per adopted
+        request, so a later process restart does not replay it twice.
+        Returns the adopted rids (the dead runner abort-flushes the
+        rest).  Runs on the dying replica's supervisor thread."""
+        dead = self.replicas[dead_idx]
+        alive = [i != dead_idx and r.crashed is None
+                 for i, r in enumerate(self.replicas)]
+        if not any(alive):
+            return set()
+        self._dead.add(dead_idx)
+        self.router.forget_replica(dead_idx)
+        dead_journal = getattr(dead.engine, "journal", None)
+        adopted: set[int] = set()
+        loads = [r.inflight for r in self.replicas]
+        qd = [r.engine.scheduler.queue_depth for r in self.replicas]
+        for rec in replay:
+            rid = rec["rid"]
+            key = self.router.affinity_key(rec["prompt"])
+            idx, _ = self.router.route(key, loads=loads,
+                                       queue_depths=qd, alive=alive)
+            ent = dead._live.pop(rid, None)
+            if ent is not None:
+                self.replicas[idx]._live[rid] = ent
+            self._owner[rid] = idx
+            self.replicas[idx]._cmds.put(("recover", rec))
+            if dead_journal is not None:
+                dead_journal.terminal(rid, "drained")
+            loads[idx] += 1
+            adopted.add(rid)
+        if adopted:
+            import sys
+
+            print(f"[serve] replica {dead_idx} terminal: drained "
+                  f"{len(adopted)} in-flight streams to live peers",
+                  file=sys.stderr)
+        return adopted
+
     # -- scrape rendering ----------------------------------------------
     def render_metrics(self, extra_gauges: dict[str, float] | None = None,
                        ) -> str:
@@ -545,22 +633,33 @@ class ReplicaRunner:
             engine = runner.engine
             stats = engine.pool.stats()
             recov = runner.recovery_latency_s
+            per_gauges = {
+                "pool_blocks_free": stats["free"],
+                "pool_blocks_request_held": stats["request_held"],
+                "pool_blocks_cache_only": stats["cache_only"],
+                "pool_kv_bytes_shard": stats["kv_bytes_shard"],
+                "pool_kv_shards": stats["kv_shards"],
+                "inflight_streams": runner.inflight,
+                "queue_depth_live": engine.scheduler.queue_depth,
+                "restarts_total": runner.restarts,
+                "degraded": 1.0 if runner.state != "ok" else 0.0,
+                "recovery_latency_s_last": recov[-1] if recov else 0.0,
+                "decode_impl_degraded": (
+                    1.0 if engine.decode_degraded else 0.0
+                ),
+            }
+            journal = runner.journal
+            if journal is not None:
+                jstats = journal.stats()
+                per_gauges.update({
+                    "journal_records_total": float(jstats["records"]),
+                    "journal_fsync_p99_s": jstats["fsync_p99_s"],
+                    "journal_write_errors_total": float(
+                        jstats["write_errors"] + jstats["fsync_errors"]),
+                    "journal_epoch": float(jstats["epoch"]),
+                })
             text = engine.metrics.prometheus(
-                extra_gauges={
-                    "pool_blocks_free": stats["free"],
-                    "pool_blocks_request_held": stats["request_held"],
-                    "pool_blocks_cache_only": stats["cache_only"],
-                    "pool_kv_bytes_shard": stats["kv_bytes_shard"],
-                    "pool_kv_shards": stats["kv_shards"],
-                    "inflight_streams": runner.inflight,
-                    "queue_depth_live": engine.scheduler.queue_depth,
-                    "restarts_total": runner.restarts,
-                    "degraded": 1.0 if runner.state != "ok" else 0.0,
-                    "recovery_latency_s_last": recov[-1] if recov else 0.0,
-                    "decode_impl_degraded": (
-                        1.0 if engine.decode_degraded else 0.0
-                    ),
-                },
+                extra_gauges=per_gauges,
                 const_labels={"replica": str(i)},
             )
             lines = []

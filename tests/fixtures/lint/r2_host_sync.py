@@ -48,3 +48,25 @@ class FakeEngine:
         # deliver phase body in the tick is exempt; this helper is only
         # reached from the exempt span, so it is not scanned
         return int(nxt_host[0]) + depth
+
+
+class ReplicaSet:
+    """The fleet tick (FLEET_TICK_METHODS): no tracer.tick phase tuple,
+    so there is NO exempt span — any sync in the loop stalls every
+    replica at once."""
+
+    def step(self):
+        has_work = False
+        for engine in self.engines:
+            has_work |= engine.step()
+        self.loads.append(self.depth_dev.item())  # BITE .item() in the fleet tick
+        return has_work and self._any_alive()
+
+    def _any_alive(self):
+        import jax
+
+        return jax.device_get(self.alive_dev)  # BITE device_get in reached helper
+
+    def snapshot(self):
+        # not a tick method and not reached from one: not scanned
+        return float(self.depth_dev.item())

@@ -1,5 +1,6 @@
 """R3 bite fixture: engine-owned state mutated off the engine thread,
-and lock-protected state mutated without its lock.
+router/journal-owned state mutated outside their owning domains, and
+lock-protected state mutated without its lock.
 
 Declares its own domain/lock annotations via the module-level
 ``LINT_THREAD_DOMAINS`` / ``LINT_LOCKED_STATE`` literals — the same
@@ -12,6 +13,8 @@ LINT_THREAD_DOMAINS = {
     "Handler.*": "loop",
     "Watchdog.*": "supervisor",
     "TickLoop.*": "engine",
+    "Router.*": "router",
+    "Writer.*": "journal",
 }
 
 LINT_LOCKED_STATE = {
@@ -26,15 +29,35 @@ class Handler:
         depth = len(self.engine.scheduler.queue)  # benign read: NOT a finding
         return depth
 
+    def reroute(self, key):
+        self.router._sticky[key] = 2  # BITE router-owned state off the router
+        self.router.routed += 1  # BITE router verdict counter off the router
+        idx = self.router.route(key)  # API call: NOT a finding
+        return idx
+
 
 class Watchdog:
     def on_hang(self):
         self.engine.pool.pages = None  # BITE supervisor-domain mutation
 
 
+class Router:
+    def route(self, key):
+        self._sticky[key] = 0  # the router's own method: NOT a finding
+        self.routed += 1
+        return 0
+
+
+class Writer:
+    def _writer_loop(self):
+        self._wlive[1] = {}  # journal domain owns its mirror: NOT a finding
+        self.engine.scheduler.queue.append(1)  # BITE engine state from journal domain
+
+
 class TickLoop:
     def tick(self):
         self.engine.scheduler.queue.append(1)  # engine domain: NOT a finding
+        self._wlive.clear()  # BITE journal-writer-owned state from engine domain
 
 
 class Counters:

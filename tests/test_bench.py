@@ -86,6 +86,7 @@ def test_priority_matches_config_dicts():
         + list(bench.SERVE_CONFIGS) + list(bench.SERVE_HTTP_CONFIGS)
         + list(bench.SERVE_CHAOS_CONFIGS) + list(bench.SERVE_MIXED_CONFIGS)
         + list(bench.SERVE_SHARDED_CONFIGS)
+        + list(bench.SERVE_RESTART_CONFIGS)
         if not n.startswith("smoke")
     }
     assert set(bench.PRIORITY) == non_smoke | bench.EXTRA_CHILDREN
@@ -103,7 +104,8 @@ def test_warm_smoke_offline():
                                  and n not in bench.SERVE_HTTP_CONFIGS
                                  and n not in bench.SERVE_CHAOS_CONFIGS
                                  and n not in bench.SERVE_MIXED_CONFIGS
-                                 and n not in bench.SERVE_SHARDED_CONFIGS}
+                                 and n not in bench.SERVE_SHARDED_CONFIGS
+                                 and n not in bench.SERVE_RESTART_CONFIGS}
 
 
 def test_warm_limit_covers_top_priority_only():
@@ -217,6 +219,31 @@ def test_serve_chaos_smoke_offline():
     assert res["recovery_latency_s_max"] > 0
     assert res["client_retries_total"] >= 2  # the injected 429s
     assert res["compile_counts"]["decode_step"] == 1
+
+
+@pytest.mark.http
+@pytest.mark.proc
+def test_serve_restart_smoke_offline():
+    """The kill -9 durability child: plain / journaled / SIGKILL+respawn
+    server subprocesses on one trace — token parity across the kill,
+    at least one client resumed via Last-Event-ID, the journal overhead
+    pair recorded (with the off-thread fsync p99), and a clean final
+    drain leaving an empty replay set."""
+    res = bench._spawn("smoke_serve_restart", 600,
+                       env={"BENCH_PLATFORM": "cpu"})
+    assert res.get("ok") is True, res
+    assert res["token_parity_journaled_vs_plain"] is True
+    assert res["token_parity_across_kill"] is True
+    assert res["streams_resumed"] >= 1
+    # None is legal when every cut landed after a stream's final token
+    # (the resume then replays only the parked finish)
+    lat = res["restart_to_first_resumed_token_s"]
+    assert lat is None or lat > 0
+    assert res["journal_fsync_p99_s"] is not None
+    assert res["journal_replayed_total"] >= 1
+    assert res["journal_resumed_total"] >= 1
+    assert res["journal_overhead_ok"] is True
+    assert res["drain_left_unterminated"] == 0
 
 
 def test_decomp_smoke_offline():

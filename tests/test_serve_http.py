@@ -107,10 +107,18 @@ def test_sse_framing_roundtrip():
     assert parse_sse_line(frame.strip()) == {
         "choices": [{"text": "ab", "token_id": 7}]
     }
+    # token frames carry the delivered-token index as the SSE event id
+    # (the Last-Event-ID resume handle, serve/journal.py)
+    frame = sse_event({"choices": [{"token_id": 7}]}, event_id=3)
+    assert frame.startswith(b"id: 3\ndata: ")
     assert parse_sse_line(DONE_SENTINEL.strip()) is None
     assert parse_sse_line(b": comment") is None
+    # non-data SSE fields are skipped, not errors
+    assert parse_sse_line(b"id: 3") is None
+    assert parse_sse_line(b"event: weird") is None
+    assert parse_sse_line(b"retry: 100") is None
     with pytest.raises(ValueError):
-        parse_sse_line(b"event: weird")
+        parse_sse_line(b"garbage line")
 
 
 def test_parse_completion_request_validation():
@@ -216,8 +224,10 @@ def test_http_routes_errors_and_unary(tiny):
 
 def test_http_sse_stream_framing_raw(tiny):
     """Raw SSE bytes: event-stream content type, one ``data:`` frame per
-    token with token_id, a final frame carrying finish_reason, then the
-    [DONE] sentinel, then EOF — and the tokens match offline."""
+    token with token_id — each preceded by an ``id:`` line carrying the
+    delivered-token index (the Last-Event-ID resume handle) — a final
+    frame carrying finish_reason, then the [DONE] sentinel, then EOF —
+    and the tokens match offline."""
     cfg, params = tiny
     engine = _engine(cfg, params)
     prompt, n = [3, 9, 4], 5
@@ -230,13 +240,16 @@ def test_http_sse_stream_framing_raw(tiny):
             {"prompt": prompt, "max_tokens": n, "stream": True})
         assert st == 200
         assert hdr["content-type"].startswith("text/event-stream")
-        frames, saw_done = [], False
+        frames, event_ids, saw_done = [], [], False
         while True:
             line = await reader.readline()
             if not line:
                 break
             if line.strip() == b"data: [DONE]":
                 saw_done = True
+                continue
+            if line.startswith(b"id: "):
+                event_ids.append(int(line.split()[1]))
                 continue
             if line.strip():
                 assert line.startswith(b"data: "), line
@@ -249,6 +262,8 @@ def test_http_sse_stream_framing_raw(tiny):
         assert final["finish_reason"] == "length"
         assert [f["choices"][0]["token_id"] for f in token_frames] \
             == _offline_tokens(cfg, params, prompt, n)
+        # event ids = 1-based delivered-token indices, one per token
+        assert event_ids == list(range(1, len(token_frames) + 1))
         srv.begin_drain()
         await srv.serve_until_shutdown()
 
@@ -272,7 +287,11 @@ def test_http_queue_full_returns_429_with_retry_after(tiny):
             host, port, {"prompt": [5] * 6, "max_tokens": 40,
                          "stream": True})
         assert st == 200
-        assert (await reader_a.readline()).startswith(b"data: ")
+        # first token frame: the id: line, then its data: line
+        line = await reader_a.readline()
+        if line.startswith(b"id: "):
+            line = await reader_a.readline()
+        assert line.startswith(b"data: ")
         # B: fills the one queue seat (poll the scheduler until it lands)
         st_b, _, reader_b, writer_b = await _raw_post(
             host, port, {"prompt": [6] * 6, "max_tokens": 4,

@@ -422,6 +422,35 @@ def _self_check() -> None:
     rebuilt.tracer = None
     print(f"compile counts OK (traced): {rebuilt.compile_counts()}")
 
+    # journaling is host-side only (serve/journal.py): admissions,
+    # per-tick delivery watermarks, and terminals are enqueued to the
+    # writer THREAD — the step jaxprs cannot see the journal, so
+    # attaching one and replaying traffic must compile NOTHING new
+    import tempfile
+
+    from llm_np_cp_tpu.serve.journal import RequestJournal, scan_journal
+
+    with tempfile.TemporaryDirectory() as td:
+        jpath = os.path.join(td, "serve.journal")
+        journal = RequestJournal(jpath)
+        rebuilt.journal = journal
+        warm = dict(rebuilt.compile_counts())
+        with CompileCounter().watch() as counter:
+            for p in prompts:
+                rebuilt.submit(p, 6)
+            rebuilt.run_until_complete()
+        assert counter.count == 0, (
+            f"journaling compiled: {counter.events}"
+        )
+        assert rebuilt.compile_counts() == warm
+        assert journal.flush(10.0)
+        assert journal.stats()["records"] > 0, "journal recorded nothing"
+        live, _, _ = scan_journal(jpath)
+        assert live == {}, f"finished traffic left a replay set: {live}"
+        journal.close()
+        rebuilt.journal = None
+    print(f"compile counts OK (journaled): {rebuilt.compile_counts()}")
+
 
 if __name__ == "__main__":
     _self_check()

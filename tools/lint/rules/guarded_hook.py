@@ -1,9 +1,10 @@
 """R4 — guarded-hook discipline for the optional hot-path hooks.
 
 The serve stack's optional instruments — the ``tracer``
-(serve/tracing.TraceRecorder) and the ``faults`` chaos injector
-(serve/faults.FaultInjector) — are OFF by default, spelled as ``None``
-attributes.  The zero-overhead contract is that every hook call sits
+(serve/tracing.TraceRecorder), the ``faults`` chaos injector
+(serve/faults.FaultInjector), and the ``journal`` durable request
+journal (serve/journal.RequestJournal) — are OFF by default, spelled
+as ``None`` attributes.  The zero-overhead contract is that every hook call sits
 behind an ``is None`` / ``is not None`` check in the same function, so
 instruments-off costs an attribute load and a branch: no dict built for
 a recorder that is not there, no allocation the hot loop did not make
@@ -36,10 +37,10 @@ from tools.lint.core import (
 
 RULE_ID = "R4"
 
-HOOKS = ("tracer", "faults")
-# engine methods where binding self.tracer/self.metrics to a local is
-# fine: construction, cloning, and the warmup suspend/restore swap —
-# none of them run inside a supervised tick
+HOOKS = ("tracer", "faults", "journal")
+# engine methods where binding self.tracer/self.metrics/self.journal to
+# a local is fine: construction, cloning, and the warmup
+# suspend/restore swap — none of them run inside a supervised tick
 _CACHE_EXEMPT = {"__init__", "clone_fresh", "warmup", "_warmup_body",
                  "replay_trace"}
 
@@ -159,7 +160,7 @@ class _Rule:
                 chain = attr_chain(node.value)
                 if chain is None or len(chain) != 2 or chain[0] != "self":
                     continue
-                if chain[1] not in ("tracer", "metrics"):
+                if chain[1] not in ("tracer", "metrics", "journal"):
                     continue
                 if not any(isinstance(t, ast.Name) for t in node.targets):
                     continue

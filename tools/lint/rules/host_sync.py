@@ -13,7 +13,12 @@ the same phase boundaries the trace reports, with no shadow table to
 drift.
 
 Scope: tick methods plus every ``self._helper()`` they (transitively)
-call from a NON-exempt phase.  Within that scope:
+call from a NON-exempt phase.  Tick methods are recovered from the
+``tracer.tick`` call, PLUS the qualnames in ``FLEET_TICK_METHODS`` —
+the replica fleet tick (``ReplicaSet.step``) emits no phase slices, so
+NOTHING in it is exempt: the fleet loop drives N engines' ticks
+back-to-back, and a host sync there stalls every replica at once.
+Within that scope:
 
 - ``.item()``, ``jax.device_get(...)``, ``.block_until_ready()`` —
   flagged unconditionally.
@@ -42,6 +47,10 @@ from tools.lint.core import (
 RULE_ID = "R2"
 
 EXEMPT_PHASES = {"host_sync", "deliver"}
+# fleet-tick methods scanned WITHOUT any exempt phase spans (no
+# tracer.tick call to recover them from), matched by qualname so the
+# bite fixture's fake ReplicaSet exercises the same path
+FLEET_TICK_METHODS = ("ReplicaSet.step",)
 # engine attributes whose call results live on device
 _DEVICE_CALL_RE = re.compile(
     r"^_(dispatch_\w+|mixed_step|decode_step|prefill_step|sample_first"
@@ -118,7 +127,8 @@ def _mentions(node: ast.AST, names: set[str]) -> bool:
 class _Rule:
     id = RULE_ID
     name = "host-sync"
-    targets = ("llm_np_cp_tpu/serve/engine.py",)
+    targets = ("llm_np_cp_tpu/serve/engine.py",
+               "llm_np_cp_tpu/serve/replica.py")
 
     def check(self, sf: SourceFile) -> list[Finding]:
         out: list[Finding] = []
@@ -137,11 +147,16 @@ class _Rule:
             name: tup for name, fn in methods.items()
             if (tup := _tick_phase_tuple(fn)) is not None
         }
+        for name in methods:
+            if (f"{cls.name}.{name}" in FLEET_TICK_METHODS
+                    and name not in ticks):
+                ticks[name] = None  # fleet tick: no exempt spans at all
         if not ticks:
             return
         # helper closure reached from non-exempt tick positions
         exempt: dict[str, list[tuple[int, int]]] = {
-            name: _exempt_spans(methods[name], tup)
+            name: (_exempt_spans(methods[name], tup)
+                   if tup is not None else [])
             for name, tup in ticks.items()
         }
 

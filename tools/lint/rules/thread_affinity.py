@@ -12,15 +12,21 @@ The rule makes that contract machine-checked, seeded from the
 annotation tables below (precise, not heuristic):
 
 - ``DOMAIN_TABLE`` assigns every function a domain (``engine`` /
-  ``loop`` / ``supervisor`` / ``shared``) by (file, qualname) glob —
-  first match wins.  A linted module may extend/override with a
-  module-level ``LINT_THREAD_DOMAINS = {"Qualname.glob": "domain"}``
-  literal (how the bite fixture declares itself).
-- ``OWNED_STATE`` lists engine-thread-owned attributes by dotted-chain
-  suffix.  MUTATING them (assign/augassign/del, mutator method calls,
-  subscript stores) from a non-engine domain is a finding.  Plain reads
-  are deliberately not flagged: the stack's benign racy reads (queue
-  depth gauges for scrapes/routing) are part of the documented design.
+  ``loop`` / ``supervisor`` / ``shared`` / ``router`` / ``journal``)
+  by (file, qualname) glob — first match wins.  A linted module may
+  extend/override with a module-level ``LINT_THREAD_DOMAINS =
+  {"Qualname.glob": "domain"}`` literal (how the bite fixture declares
+  itself).
+- ``DOMAIN_OWNED`` lists domain-owned attributes by dotted-chain
+  suffix: engine-thread state (scheduler queues, pool pages), the
+  PrefixRouter's routing state (the ROADMAP router-ownership domain —
+  loop-owned in HTTP mode, engine-owned in direct mode, so ALL
+  mutations must go through the router's own methods), and the journal
+  writer thread's file/mirror state.  MUTATING one (assign/augassign/
+  del, mutator method calls, subscript stores) from outside its owning
+  domain is a finding.  Plain reads are deliberately not flagged: the
+  stack's benign racy reads (queue depth gauges for scrapes/routing)
+  are part of the documented design.
 - ``LOCK_STATE`` lists lock-protected attribute groups.  Mutating one
   outside a ``with <base>.<lock>:`` block is a finding unless the
   function is in the group's ``lock_assumed`` set ("caller holds the
@@ -43,6 +49,9 @@ DOMAIN_TABLE: tuple[tuple[str, str, str], ...] = (
     ("serve/http/server.py", "EngineRunner._exec*", "engine"),
     ("serve/http/server.py", "EngineRunner._run", "engine"),
     ("serve/http/server.py", "EngineRunner._rebuild_and_replay*", "engine"),
+    ("serve/http/server.py", "EngineRunner._replay_one", "engine"),
+    ("serve/http/server.py", "EngineRunner._finish_replayed", "engine"),
+    ("serve/http/server.py", "EngineRunner._stash_resumable", "engine"),
     ("serve/http/server.py", "EngineRunner._bridge*", "engine"),
     ("serve/http/server.py", "EngineRunner._next_handback", "engine"),
     ("serve/http/server.py", "EngineRunner._watch", "supervisor"),
@@ -50,6 +59,17 @@ DOMAIN_TABLE: tuple[tuple[str, str, str], ...] = (
     ("serve/http/server.py", "EngineRunner._terminal_crash", "supervisor"),
     ("serve/http/server.py", "*", "loop"),
     ("serve/http/*.py", "*", "loop"),
+    # the journal WRITER THREAD owns the file handle + compaction
+    # mirror; everything else in serve/journal.py runs on the engine
+    # tick thread (the enqueue-side hooks)
+    ("serve/journal.py", "RequestJournal._writer*", "journal"),
+    ("serve/journal.py", "*", "engine"),
+    # the ROADMAP router-ownership domain: PrefixRouter's own methods
+    # are the only code allowed to mutate routing state — the fleet is
+    # loop-owned in HTTP mode (ReplicaRunner) and engine-owned in
+    # direct mode (ReplicaSet), so the single-writer contract is "all
+    # router-state mutations go through the PrefixRouter API"
+    ("serve/replica.py", "PrefixRouter.*", "router"),
     ("serve/replica.py", "ReplicaRunner.*", "loop"),
     ("serve/replica.py", "*", "engine"),
     ("serve/metrics.py", "*", "shared"),
@@ -70,6 +90,39 @@ OWNED_STATE: tuple[tuple[str, ...], ...] = (
     ("pool", "pages"),
     ("engine", "_requests"),
     ("engine", "_detok"),
+)
+
+# router-owned state (the PrefixRouter ownership domain): MUTATED only
+# by PrefixRouter's own methods — ReplicaRunner (loop) and ReplicaSet
+# (engine) both hold a router, so reaching into its sticky map or
+# verdict counters from either owner's code is a finding; they must
+# call route()/forget_replica() instead.
+ROUTER_STATE: tuple[tuple[str, ...], ...] = (
+    ("router", "_sticky"),
+    ("router", "_rr"),
+    ("router", "routed"),
+    ("router", "spilled"),
+)
+
+# journal-writer-thread-owned state (serve/journal.py): the ``_w``
+# prefix marks attributes only the writer thread touches — the open
+# file handle, the live-request mirror compaction snapshots from, and
+# the bytes-since-compaction counter.  Engine-side hooks communicate
+# through the lock-protected pending queue only.
+JOURNAL_STATE: tuple[tuple[str, ...], ...] = (
+    ("_wfile",),
+    ("_wlive",),
+    ("_wsince",),
+)
+
+# (owning domain, state table, remediation hint)
+DOMAIN_OWNED: tuple[tuple[str, tuple, str], ...] = (
+    ("engine", OWNED_STATE,
+     "route through the engine command queue instead"),
+    ("router", ROUTER_STATE,
+     "go through the PrefixRouter API (route/forget_replica) instead"),
+    ("journal", JOURNAL_STATE,
+     "enqueue a record for the writer thread instead"),
 )
 
 # lock-protected groups: attrs of a class that may only be MUTATED under
@@ -107,6 +160,18 @@ LOCK_STATE: tuple[dict, ...] = (
         "class": "FaultInjector",
         "lock": "_lock",
         "attrs": {"hits", "injected", "_rngs"},
+        "lock_assumed": set(),
+    },
+    {
+        # the journal's engine↔writer boundary: the pending queue and
+        # the stats counters are the ONLY shared state, and every
+        # mutation takes the lock
+        "file": "serve/journal.py",
+        "class": "RequestJournal",
+        "lock": "_lock",
+        "attrs": {"_pending", "_stopping", "n_records", "bytes_written",
+                  "n_fsyncs", "fsync_s", "n_write_errors",
+                  "n_fsync_errors", "n_compactions"},
         "lock_assumed": set(),
     },
 )
@@ -212,18 +277,21 @@ class _Rule:
             fn_name = qualname.rsplit(".", 1)[-1]
             cls_name = qualname.split(".")[0] if "." in qualname else None
             for chain, lineno, how in _mutations(fn):
-                # -- engine-owned state off the engine thread ----------
-                if domain != "engine":
-                    for suffix in OWNED_STATE:
-                        if chain[-len(suffix):] == suffix:
+                # -- domain-owned state mutated outside its domain -----
+                # (constructors are exempt: object construction is
+                # single-threaded by nature)
+                if fn_name != "__init__":
+                    for owner, table, hint in DOMAIN_OWNED:
+                        if domain == owner:
+                            continue
+                        if any(chain[-len(s):] == s for s in table):
                             out.append(Finding(
                                 rule=self.id, path=sf.rel, line=lineno,
                                 message=(
-                                    f"{how} on engine-thread-owned state "
-                                    f"'{'.'.join(chain)}' from "
+                                    f"{how} on {owner}-thread-owned "
+                                    f"state '{'.'.join(chain)}' from "
                                     f"{domain}-domain {qualname}() — "
-                                    "route through the engine command "
-                                    "queue instead"
+                                    f"{hint}"
                                 ),
                             ))
                             break
