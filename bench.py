@@ -374,10 +374,10 @@ TIMEOUTS = {
     # the sharded legs re-place params + pool per topology and the DP
     # leg warms every replica
     "serve_sharded_poisson": 850,
-    # FOUR server subprocesses (plain / journaled / kill / restart),
-    # each paying its own model build + warmup, plus the realtime
-    # client traffic spans
-    "serve_restart_poisson": 1100,
+    # FIVE server subprocesses (plain / journaled / journaled_sync /
+    # kill / restart), each paying its own model build + warmup, plus
+    # the realtime client traffic spans
+    "serve_restart_poisson": 1300,
     # prefill-dominated: the marginal measurement's extra prefill+half
     # decode per rep nearly doubles measured-phase wall time
     "llama3b_seq2048_bs8": 700,
@@ -1081,8 +1081,16 @@ def run_serve_sharded_config(name: str) -> dict:
     lens = [int(t["prompt"].size) for t in trace]
     _phase(name, "trace_built", t0)
 
+    from llm_np_cp_tpu.serve.slo import SLOPolicy, SLOTracker
+
+    # goodput/attainment/burn recorded per topology leg (fleet legs
+    # aggregate across replicas via ReplicaSet.snapshot); ok never
+    # depends on the attainment VALUE on a CPU child
+    slo_policy = SLOPolicy(ttft_s=spec.get("slo_ttft", 2.5),
+                           tpot_s=spec.get("slo_tpot", 1.0))
+
     def build_engine(plan, devices):
-        return ServeEngine(
+        eng = ServeEngine(
             params, config,
             sampler=Sampler(kind="greedy"),
             max_slots=spec["slots"],
@@ -1096,6 +1104,8 @@ def run_serve_sharded_config(name: str) -> dict:
             mesh_plan=plan,
             mesh_devices=devices,
         )
+        eng.metrics.slo = SLOTracker(slo_policy, clock=eng.clock)
+        return eng
 
     legs = {
         "single": dict(chips=1, replicas=1, tp=1),
@@ -1161,6 +1171,11 @@ def run_serve_sharded_config(name: str) -> dict:
             "ttft_s_p99": round(snap.get("ttft_s_p99", float("nan")), 4),
             "prefix_hit_rate": round(snap["prefix_hit_rate"], 3)
             if "prefix_hit_rate" in snap else None,
+            "slo_attainment": round(
+                snap.get("slo_attainment", float("nan")), 4),
+            "goodput_tok_s": round(snap.get("goodput_tok_s", 0.0), 1),
+            "slo_burn_rate_5m": round(
+                snap.get("slo_burn_rate_5m", 0.0), 3),
             "ticks": snap["ticks"],
             "compile_counts": compile_counts,
             **router,
@@ -1198,6 +1213,10 @@ def run_serve_sharded_config(name: str) -> dict:
         "token_parity_across_legs": parity,
         "tok_s_per_chip": headline.get("tok_s_per_chip"),
         "ttft_s_p99": headline.get("ttft_s_p99"),
+        "slo_ttft_s": slo_policy.ttft_s,
+        "slo_tpot_s": slo_policy.tpot_s,
+        "slo_attainment": headline.get("slo_attainment"),
+        "goodput_tok_s": headline.get("goodput_tok_s"),
         "live_ref": {
             "tok_s_per_chip": LIVE_REF_TOK_S_PER_CHIP,
             "source": LIVE_REF_SOURCE,
@@ -1311,6 +1330,21 @@ def run_serve_http_config(name: str) -> dict:
         prefill_chunk=chunk,
         cache_dtype=jnp.bfloat16,
     )
+    # SLO goodput accounting rides every leg: generous CPU-scale
+    # targets (this records attainment/goodput/burn alongside tok/s —
+    # tools/slo_gate.py gates live-TPU runs on them; ok never depends
+    # on the attainment VALUE, only on the plumbing)
+    from llm_np_cp_tpu.serve.slo import SLOPolicy, SLOTracker
+
+    slo_policy = SLOPolicy(ttft_s=spec.get("slo_ttft", 2.5),
+                           tpot_s=spec.get("slo_tpot", 1.0))
+
+    def fresh_metrics():
+        m = ServeMetrics(clock=engine.clock)
+        m.slo = SLOTracker(slo_policy, clock=engine.clock)
+        return m
+
+    engine.metrics = fresh_metrics()
     rng = np.random.default_rng(13)
     trace = poisson_trace(
         rng, spec["requests"], rate_rps=spec["rate"],
@@ -1333,7 +1367,7 @@ def run_serve_http_config(name: str) -> dict:
 
     # leg 2: same trace through the HTTP server, one SSE client per
     # request sleeping until its arrival time
-    engine.metrics = ServeMetrics(clock=engine.clock)
+    engine.metrics = fresh_metrics()
     engine.scheduler.finished.clear()
     results, _http_stats, prom = _run_http_trace_leg(
         engine, spec["model"], trace,
@@ -1359,11 +1393,21 @@ def run_serve_http_config(name: str) -> dict:
     # phases + profiler annotations live).  The delta vs the untraced
     # direct leg is what --trace-out costs a production replay; it must
     # stay small or the instrument perturbs what it measures.
+    import shutil
+    import tempfile
+
+    from llm_np_cp_tpu.serve.request_log import RequestLog, read_request_log
     from llm_np_cp_tpu.serve.tracing import TraceRecorder
 
-    engine.metrics = ServeMetrics(clock=engine.clock)
+    engine.metrics = fresh_metrics()
     engine.scheduler.finished.clear()
     engine.tracer = TraceRecorder(ring=500_000)
+    # the canonical request log rides the traced leg: one JSON line per
+    # terminal, asserted consistent with the metrics the same leg
+    # recorded (request-log ↔ metrics parity)
+    rl_dir = tempfile.mkdtemp(prefix="serve_http_rl_")
+    rl_path = os.path.join(rl_dir, "requests.jsonl")
+    engine.request_log = RequestLog(rl_path)
     traced = engine.replay_trace(trace, realtime=True)
     # ids keep counting across legs — compare token streams in submit
     # order (both legs replay the same arrivals through submit())
@@ -1374,7 +1418,30 @@ def run_serve_http_config(name: str) -> dict:
     )
     n_trace_events = len(engine.tracer)
     engine.tracer = None
-    _phase(name, "traced_done", t0, events=n_trace_events)
+    # request-log ↔ metrics parity: the wide-event lines and the
+    # metrics snapshot were recorded by the SAME leg, so their counts
+    # must agree exactly — one line per terminal, reasons matching the
+    # finish_reasons counters, token totals matching, every line
+    # carrying a trace id and an SLO verdict
+    engine.request_log.flush(10.0)
+    log_lines = read_request_log(rl_path)
+    engine.request_log.close()
+    engine.request_log = None
+    shutil.rmtree(rl_dir, ignore_errors=True)
+    from collections import Counter as _Counter
+
+    traced_snap = traced
+    log_reasons = dict(_Counter(ln["reason"] for ln in log_lines))
+    request_log_parity = (
+        len(log_lines) == traced_snap["finished"] + traced_snap["aborted"]
+        and log_reasons == traced_snap["finish_reasons"]
+        and sum(ln["new_tokens"] for ln in log_lines)
+        == traced_snap["total_generated_tokens"]
+        and all(ln.get("trace") for ln in log_lines)
+        and all("slo" in ln for ln in log_lines)
+    )
+    _phase(name, "traced_done", t0, events=n_trace_events,
+           log_lines=len(log_lines))
     t_p99 = traced.get("ttft_s_p99", float("nan"))
     trace_tok_delta = round(
         direct["throughput_tok_s"] - traced["throughput_tok_s"], 1)
@@ -1390,7 +1457,8 @@ def run_serve_http_config(name: str) -> dict:
         "ok": (direct["finished"] == spec["requests"]
                and len(http_ok) == spec["requests"] and parity
                and traced["finished"] == spec["requests"]
-               and trace_parity and trace_overhead_small),
+               and trace_parity and trace_overhead_small
+               and request_log_parity),
         "requests": spec["requests"],
         "rate_rps": spec["rate"],
         "slots": spec["slots"],
@@ -1415,6 +1483,26 @@ def run_serve_http_config(name: str) -> dict:
         "trace_overhead_small": trace_overhead_small,
         "trace_events": n_trace_events,
         "trace_token_parity": trace_parity,
+        # SLO goodput accounting (the slo_gate.py observables — the
+        # HTTP leg is the headline; per-leg values alongside)
+        "slo_ttft_s": slo_policy.ttft_s,
+        "slo_tpot_s": slo_policy.tpot_s,
+        "slo_attainment": round(http_snap.get("slo_attainment",
+                                              float("nan")), 4),
+        "goodput_tok_s": round(http_snap.get("goodput_tok_s", 0.0), 1),
+        "slo_burn_rate_5m": round(
+            http_snap.get("slo_burn_rate_5m", 0.0), 3),
+        "slo_burn_rate_1h": round(
+            http_snap.get("slo_burn_rate_1h", 0.0), 3),
+        "slo_attainment_direct": round(direct.get("slo_attainment",
+                                                  float("nan")), 4),
+        "goodput_tok_s_direct": round(direct.get("goodput_tok_s", 0.0), 1),
+        "slo_attainment_traced": round(traced.get("slo_attainment",
+                                                  float("nan")), 4),
+        "goodput_tok_s_traced": round(traced.get("goodput_tok_s", 0.0), 1),
+        # canonical request log (traced leg)
+        "request_log_lines": len(log_lines),
+        "request_log_parity": request_log_parity,
         "compile_counts": engine.compile_counts(),
     }
 
@@ -1545,7 +1633,7 @@ def run_serve_chaos_config(name: str) -> dict:
 
 
 def _spawn_serve_proc(spec, tmp, tag, *, port=0, journal=None,
-                      chaos=None, timeout=600.0):
+                      journal_sync=None, chaos=None, timeout=600.0):
     """Spawn tools/serve_proc.py (deterministic random-weight model, so
     a restarted process serves the identical model) and wait for its
     port file → ``(proc, host, port)``."""
@@ -1563,6 +1651,8 @@ def _spawn_serve_proc(spec, tmp, tag, *, port=0, journal=None,
         cmd += ["--platform", plat]
     if journal:
         cmd += ["--journal", journal]
+    if journal_sync:
+        cmd += ["--journal-sync", journal_sync]
     if chaos:
         cmd += ["--chaos", chaos]
     log_path = os.path.join(tmp, f"log_{tag}")
@@ -1584,9 +1674,11 @@ def _spawn_serve_proc(spec, tmp, tag, *, port=0, journal=None,
 
 def run_serve_restart_config(name: str) -> dict:
     """kill -9 durability: REAL server subprocesses, one Poisson trace,
-    three legs — plain (no journal), journaled (the overhead leg: the
+    four legs — plain (no journal), journaled (the overhead leg: the
     client tok/s delta + the writer thread's fsync p99 IS the journal's
-    cost), and a kill leg (chaos ``proc_kill`` SIGKILLs the server
+    cost), journaled with ``--journal-sync admission`` (the strict
+    mode's cost: one synchronous admission fsync before each stream
+    starts), and a kill leg (chaos ``proc_kill`` SIGKILLs the server
     mid-decode; the parent respawns it on the same port + journal and
     every client resumes its stream via Last-Event-ID).  Token parity
     across ALL legs is the teacher-forced replay contract applied to
@@ -1682,6 +1774,24 @@ def run_serve_restart_config(name: str) -> dict:
     journaled_parity = [r["token_ids"] for r in jr_results] == plain_tokens
     _phase(name, "journaled_done", t0)
 
+    # -- leg 2b: strict-durability journal (--journal-sync admission —
+    # every admission record fsyncs BEFORE its stream starts, closing
+    # the async-fsync admission-loss window); the delta vs the async
+    # journaled leg is what the strict mode costs
+    j_sync = os.path.join(tmp, "sync.journal")
+    proc, host, port = _spawn_serve_proc(
+        spec, tmp, "journaled_sync", journal=j_sync,
+        journal_sync="admission")
+    try:
+        js_results, js_wall = drive(host, port, retries=2)
+        sync_fsync_p99 = scrape(host, port,
+                                r"^llm_serve_journal_fsync_p99_s (\S+)")
+    finally:
+        proc.send_signal(_signal.SIGTERM)
+        proc.wait(timeout=90)
+    sync_parity = [r["token_ids"] for r in js_results] == plain_tokens
+    _phase(name, "journaled_sync_done", t0)
+
     # -- leg 3: kill -9 mid-decode, respawn on the same port + journal,
     # clients resume via Last-Event-ID
     j_kill = os.path.join(tmp, "kill.journal")
@@ -1732,6 +1842,7 @@ def run_serve_restart_config(name: str) -> dict:
     live, _, epoch = scan_journal(j_kill)
     plain_stats = leg_stats(plain_results, plain_wall)
     jr_stats = leg_stats(jr_results, jr_wall)
+    js_stats = leg_stats(js_results, js_wall)
     overhead_tok_s = round(
         plain_stats["client_tok_s"] - jr_stats["client_tok_s"], 1)
     # generous: this guards a broken hot path (fsync on the tick
@@ -1739,14 +1850,21 @@ def run_serve_restart_config(name: str) -> dict:
     overhead_ok = (
         jr_stats["client_tok_s"] >= 0.5 * plain_stats["client_tok_s"]
     )
+    # the strict mode pays one synchronous fsync per ADMISSION (not per
+    # token), so its throughput floor is looser but still a floor: a
+    # broken implementation fsyncing per tick/token would crater it
+    sync_overhead_ok = (
+        js_stats["client_tok_s"] >= 0.3 * plain_stats["client_tok_s"]
+    )
     n = spec["requests"]
     return {
         "config": name,
         "ok": (plain_stats["completed"] == n
                and jr_stats["completed"] == n
+               and js_stats["completed"] == n
                and len([r for r in kill_results if r["status"] == 200]) == n
-               and journaled_parity and kill_parity
-               and bool(resumed) and overhead_ok
+               and journaled_parity and kill_parity and sync_parity
+               and bool(resumed) and overhead_ok and sync_overhead_ok
                and proc1.returncode == -_signal.SIGKILL
                and live == {}),
         "requests": n,
@@ -1762,6 +1880,14 @@ def run_serve_restart_config(name: str) -> dict:
         "journal_records": records,
         "ttft_s_p99_plain": plain_stats["ttft_s_p99"],
         "ttft_s_p99_journaled": jr_stats["ttft_s_p99"],
+        # strict admission-fsync mode (--journal-sync admission)
+        "token_parity_sync_vs_plain": sync_parity,
+        "client_tok_s_journaled_sync": js_stats["client_tok_s"],
+        "sync_admission_overhead_tok_s": round(
+            jr_stats["client_tok_s"] - js_stats["client_tok_s"], 1),
+        "sync_admission_overhead_ok": sync_overhead_ok,
+        "ttft_s_p99_journaled_sync": js_stats["ttft_s_p99"],
+        "journal_fsync_p99_s_sync": sync_fsync_p99,
         # the kill -9 headline
         "token_parity_across_kill": kill_parity,
         "streams_resumed": len(resumed),

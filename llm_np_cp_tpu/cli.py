@@ -215,6 +215,37 @@ def _add_serve_engine_flags(p: argparse.ArgumentParser,
                    "(bounded for long-running servers; served live at "
                    "GET /debug/trace).  0 = unbounded when --trace-out "
                    "is set, else tracing off")
+    p.add_argument("--slo-ttft", type=float, default=0.0, metavar="S",
+                   help="SLO target: time to first token, seconds.  With"
+                   " --slo-tpot this turns on goodput accounting — "
+                   "slo_attainment, goodput_tok_s and 5m/1h error-budget"
+                   " burn rates on /metrics plus GET /debug/slo.  "
+                   "0 = no TTFT target")
+    p.add_argument("--slo-tpot", type=float, default=0.0, metavar="S",
+                   help="SLO target: time per output token (steady "
+                   "decode cadence), seconds.  0 = no TPOT target")
+    p.add_argument("--slo-target", type=float, default=0.99, metavar="F",
+                   help="attainment objective the burn rate reads its "
+                   "error budget from (0.99 = 1%% of requests may miss)")
+    p.add_argument("--request-log", default=None, metavar="PATH",
+                   help="canonical request log: ONE structured JSON "
+                   "line per terminal request (trace id, route+spills, "
+                   "prefix blocks hit, restarts/replays/drains "
+                   "survived, per-phase latency breakdown, finish "
+                   "reason, SLO verdict), written off the tick thread. "
+                   "Default: off (hooks are zero-overhead no-ops)")
+    p.add_argument("--tick-sentinel", action=argparse.BooleanOptionalAction,
+                   default=False,
+                   help="tick anomaly sentinel: rolling per-phase EWMA "
+                   "baselines over the tick-phase slices; an outlier "
+                   "tick emits a trace instant naming the guilty phase "
+                   "and bumps llm_serve_anomaly_ticks_total{phase=}.  "
+                   "Implies host tracing (the sentinel rides the "
+                   "tracer's phase timestamps)")
+    p.add_argument("--sentinel-threshold", type=float, default=8.0,
+                   metavar="K",
+                   help="sentinel sensitivity: a phase is an outlier "
+                   "past baseline + K deviations")
     p.add_argument("--jax-profile", default=None, metavar="DIR",
                    help="capture a jax.profiler device trace into DIR "
                    "for the run; the serve dispatch phases are wrapped "
@@ -312,6 +343,16 @@ def build_http_serve_parser(default_model: str) -> argparse.ArgumentParser:
                    help="rewrite the journal as a live-set snapshot "
                    "whenever N appended bytes accumulate (bounds file "
                    "growth; replay-equivalent by construction)")
+    p.add_argument("--journal-sync", choices=["async", "admission"],
+                   default="async",
+                   help="journal durability mode: 'async' (default) "
+                   "fsyncs off the tick thread — an admission accepted "
+                   "in the sub-tick window before a kill -9 can be "
+                   "lost (clients retry, so this is usually fine); "
+                   "'admission' fsyncs each admission record "
+                   "SYNCHRONOUSLY before the stream starts, closing "
+                   "that window at the cost of one fsync of admission "
+                   "latency (measured in serve_restart_poisson)")
     p.add_argument("--port-file", default=None, metavar="PATH",
                    help="write 'host port' to PATH once listening "
                    "(readiness for scripts and tests)")
@@ -337,6 +378,17 @@ def _validate_pool_flags(args) -> None:
             f"--tick-token-budget must be 0 (auto) or >= --slots "
             f"({args.slots}) so decode rows are never starved, got "
             f"{budget}"
+        )
+    for flag in ("slo_ttft", "slo_tpot"):
+        if getattr(args, flag, 0.0) < 0:
+            raise SystemExit(
+                f"--{flag.replace('_', '-')} must be >= 0 "
+                f"(0 = no target), got {getattr(args, flag)}"
+            )
+    target = getattr(args, "slo_target", 0.99)
+    if not (0.0 < target < 1.0):
+        raise SystemExit(
+            f"--slo-target must be in (0, 1), got {target}"
         )
 
 
@@ -420,7 +472,8 @@ def _build_serve_engine(args, params, config, *, prog: str,
                         tokenizer=None, max_queue: int | None = None,
                         fault_injector=None, mesh_plan=None,
                         mesh_devices=None, shared_tracer=None,
-                        journal=None, quiet=False):
+                        journal=None, shared_request_log=None,
+                        quiet=False):
     """The shared engine build for both serve subcommands: validate the
     pool flags, resolve --attn-impl against the Mosaic probe (an EXPLICIT
     paged request must fail with an actionable message when the kernel
@@ -468,21 +521,42 @@ def _build_serve_engine(args, params, config, *, prog: str,
     # a single is-None check when it is None
     tracer = shared_tracer
     jax_profile = getattr(args, "jax_profile", None)
-    if tracer is None and (args.trace_out or args.trace_ring or jax_profile):
+    sentinel_on = getattr(args, "tick_sentinel", False)
+    if tracer is None and (args.trace_out or args.trace_ring
+                           or jax_profile or sentinel_on):
         from llm_np_cp_tpu.serve.tracing import TraceRecorder
 
         ring = args.trace_ring or None
         if ring is None and not args.trace_out:
-            # --jax-profile alone: the recorder exists for its
-            # annotation scopes — keep its memory bounded
+            # --jax-profile / --tick-sentinel alone: the recorder
+            # exists for its annotation scopes / phase timestamps —
+            # keep its memory bounded
             ring = 100_000
         tracer = TraceRecorder(ring=ring)
+        implied = (jax_profile or sentinel_on) \
+            and not (args.trace_out or args.trace_ring)
         print(f"[{prog}] tracing ACTIVE (ring={ring or 'unbounded'}"
               + (f", dump to {args.trace_out}" if args.trace_out else "")
-              + (", implied by --jax-profile"
-                 if jax_profile and not (args.trace_out or args.trace_ring)
-                 else "")
+              + (", implied by --jax-profile/--tick-sentinel"
+                 if implied else "")
               + ")")
+    sentinel = None
+    if sentinel_on:
+        from llm_np_cp_tpu.serve.slo import TickSentinel
+
+        sentinel = TickSentinel(
+            threshold=getattr(args, "sentinel_threshold", 8.0))
+        if not quiet:
+            print(f"[{prog}] tick sentinel ACTIVE "
+                  f"(threshold {sentinel.threshold:g} deviations)")
+    request_log = shared_request_log
+    rl_path = getattr(args, "request_log", None)
+    if request_log is None and rl_path:
+        from llm_np_cp_tpu.serve.request_log import RequestLog
+
+        request_log = RequestLog(rl_path)
+        print(f"[{prog}] request log ACTIVE: {rl_path} "
+              "(one JSON line per terminal)")
 
     # same chunking as bench.run_serve_config, so the README's CLI line
     # compiles the same prefill programs as the recorded bench numbers
@@ -512,7 +586,24 @@ def _build_serve_engine(args, params, config, *, prog: str,
         mesh_plan=mesh_plan,
         mesh_devices=mesh_devices,
         journal=journal,
+        request_log=request_log,
+        sentinel=sentinel,
     )
+    slo_ttft = getattr(args, "slo_ttft", 0.0) or None
+    slo_tpot = getattr(args, "slo_tpot", 0.0) or None
+    if slo_ttft or slo_tpot:
+        from llm_np_cp_tpu.serve.slo import SLOPolicy, SLOTracker
+
+        engine.metrics.slo = SLOTracker(
+            SLOPolicy(ttft_s=slo_ttft, tpot_s=slo_tpot,
+                      target=getattr(args, "slo_target", 0.99)),
+            clock=engine.clock,
+        )
+        if not quiet:
+            print(f"[{prog}] SLO accounting ACTIVE: "
+                  f"ttft<={slo_ttft or '-'}s tpot<={slo_tpot or '-'}s "
+                  f"target {getattr(args, 'slo_target', 0.99):g} "
+                  "(goodput/burn on /metrics, GET /debug/slo)")
     if quiet:
         return engine, num_blocks
     if engine.mesh is not None:
@@ -579,6 +670,7 @@ def _run_serve_bench(argv: list[str], default_model: str) -> str:
                 args, params, config, prog="serve-bench",
                 fault_injector=injector, mesh_plan=plan,
                 mesh_devices=dev_slices[i], shared_tracer=engine.tracer,
+                shared_request_log=engine.request_log,
                 quiet=True,
             )[0]
             for i in range(1, args.replicas)
@@ -643,7 +735,21 @@ def _run_serve_bench(argv: list[str], default_model: str) -> str:
         )
     else:
         out += engine.metrics.format()
+    if "goodput_tok_s" in snap:
+        att = snap.get("slo_attainment")
+        out += (
+            f"\nslo: attainment "
+            f"{att if att is None else format(att, '.3f')}, "
+            f"goodput {snap['goodput_tok_s']:.1f} tok/s, burn "
+            f"5m {snap.get('slo_burn_rate_5m', 0.0):.2f} / "
+            f"1h {snap.get('slo_burn_rate_1h', 0.0):.2f}"
+        )
     print(out)
+    if engine.request_log is not None:
+        engine.request_log.close()
+        print(f"[serve-bench] wrote "
+              f"{engine.request_log.stats()['records']} request-log "
+              f"lines to {args.request_log}")
     if args.json:
         print(_json.dumps(snap))
     return out
@@ -683,12 +789,13 @@ def _run_http_serve(argv: list[str], default_model: str) -> str:
         )
         journals = [
             RequestJournal(p, fault_injector=injector,
-                           compact_bytes=args.journal_compact_bytes)
+                           compact_bytes=args.journal_compact_bytes,
+                           sync_admissions=args.journal_sync == "admission")
             for p in paths
         ]
         replays = [j.stats()["replayed"] for j in journals]
         print(f"[serve] journal ACTIVE: {args.journal} "
-              f"(epoch {journals[0].epoch}, "
+              f"(epoch {journals[0].epoch}, sync={args.journal_sync}, "
               f"{sum(replays)} unterminated to replay)")
     tok, params, config = _load(args)
     engine, num_blocks = _build_serve_engine(
@@ -701,7 +808,8 @@ def _run_http_serve(argv: list[str], default_model: str) -> str:
             args, params, config, prog="serve", tokenizer=tok,
             max_queue=args.max_queue or None, fault_injector=injector,
             mesh_plan=plan, mesh_devices=dev_slices[i],
-            shared_tracer=engine.tracer, journal=journals[i], quiet=True,
+            shared_tracer=engine.tracer, journal=journals[i],
+            shared_request_log=engine.request_log, quiet=True,
         )[0]
         for i in range(1, args.replicas)
     ]
@@ -767,6 +875,8 @@ def _run_http_serve(argv: list[str], default_model: str) -> str:
             runner=runner,
         )
     _dump_trace(tracer, args, "serve")
+    if engine.request_log is not None:
+        engine.request_log.close()
     print("[serve] drained, bye")
     return banner
 

@@ -44,6 +44,14 @@ Modules:
   rolling deploy) replays unterminated requests token-identically on
   restart, and clients resume dropped SSE streams via
   ``Last-Event-ID``; zero-overhead is-None hooks when off.
+- ``slo``         — SLO goodput accounting (``SLOPolicy``/
+  ``SLOTracker``: attainment, goodput_tok_s, multi-window error-budget
+  burn rates) and the ``TickSentinel`` per-phase anomaly detector;
+  zero-overhead is-None hooks when off.
+- ``request_log`` — the canonical request log (``RequestLog``): one
+  wide-event JSON line per terminal request (trace id, route, prefix
+  reuse, survival lineage, per-phase latencies, SLO verdict), written
+  off the tick thread with the journal's writer discipline.
 - ``replica``     — mesh-scale-out: ``ReplicaSet``/``ReplicaRunner``
   run N data-parallel engine replicas (each optionally TP-sharded via
   ``ServeEngine(mesh_plan=...)`` on its own mesh slice) behind a
@@ -67,6 +75,13 @@ from llm_np_cp_tpu.serve.engine import (
 from llm_np_cp_tpu.serve.journal import RequestJournal, scan_journal
 from llm_np_cp_tpu.serve.metrics import ServeMetrics
 from llm_np_cp_tpu.serve.prefix_cache import PrefixCache, prefix_block_keys
+from llm_np_cp_tpu.serve.request_log import RequestLog, read_request_log
+from llm_np_cp_tpu.serve.slo import (
+    SLOPolicy,
+    SLOTracker,
+    TickSentinel,
+    aggregate_slo,
+)
 from llm_np_cp_tpu.serve.replica import (
     PrefixRouter,
     ReplicaRunner,
@@ -93,14 +108,20 @@ __all__ = [
     "ReplicaSet",
     "Request",
     "RequestJournal",
+    "RequestLog",
     "RequestState",
+    "SLOPolicy",
+    "SLOTracker",
     "Scheduler",
     "ServeEngine",
     "ServeMetrics",
+    "TickSentinel",
     "TraceRecorder",
+    "aggregate_slo",
     "poisson_trace",
     "pool_geometry",
     "prefix_block_keys",
+    "read_request_log",
     "scan_journal",
     "worst_case_slots",
 ]

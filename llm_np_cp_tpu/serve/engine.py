@@ -109,13 +109,14 @@ from llm_np_cp_tpu.serve.block_pool import BlockPool, PagedKV
 from llm_np_cp_tpu.serve.faults import FaultInjected, FaultInjector
 from llm_np_cp_tpu.serve.metrics import ServeMetrics
 from llm_np_cp_tpu.serve.prefix_cache import prefix_block_keys
+from llm_np_cp_tpu.serve.request_log import request_record
 from llm_np_cp_tpu.serve.scheduler import (
     QueueFull,
     Request,
     RequestState,
     Scheduler,
 )
-from llm_np_cp_tpu.serve.tracing import TraceRecorder
+from llm_np_cp_tpu.serve.tracing import TraceRecorder, gen_trace_id
 
 Params = dict[str, Any]
 
@@ -202,6 +203,8 @@ class ServeEngine:
         mesh_plan: Any = None,
         mesh_devices: list | None = None,
         journal: Any = None,
+        request_log: Any = None,
+        sentinel: Any = None,
     ) -> None:
         if decode_attn_impl not in ("xla", "flash_decode", "paged"):
             raise ValueError(
@@ -340,6 +343,15 @@ class ServeEngine:
         # file a restarted PROCESS replays through recover(); same
         # is-None zero-overhead discipline as faults/tracer
         self.journal = journal
+        # canonical request log (serve/request_log.py): one wide-event
+        # JSON line per terminal, written off the tick thread; same
+        # is-None zero-overhead discipline
+        self.request_log = request_log
+        # tick anomaly sentinel (serve/slo.py TickSentinel): rolling
+        # per-phase EWMA baselines over the tick-phase slices; rides
+        # the tracer's phase timestamps, so it observes only when a
+        # tracer is attached.  Same is-None discipline
+        self.sentinel = sentinel
         # reason string once the paged decode step faulted at dispatch
         # and the engine fell back to the gather impl (None = healthy)
         self.decode_degraded: str | None = None
@@ -1097,6 +1109,7 @@ class ServeEngine:
         on_event: Callable[[Request, str], None] | None = None,
         deadline_s: float | None = None,
         arrival_time: float | None = None,
+        trace_id: str | None = None,
         _recovered: bool = False,
     ) -> Request:
         prompt = np.asarray(prompt_ids, dtype=np.int32).reshape(-1)
@@ -1147,6 +1160,16 @@ class ServeEngine:
         req.submit_time = self.clock()
         if deadline_s is not None:
             req.deadline = req.submit_time + deadline_s
+        # distributed trace identity: accept the caller's W3C trace id
+        # (the HTTP layer parses/generates `traceparent`), else mint one
+        # when some instrument will record it — with everything off this
+        # stays a pair of is-None checks, no id is ever generated
+        if trace_id is None and (
+            self.tracer is not None or self.request_log is not None
+        ):
+            trace_id = gen_trace_id()
+        if trace_id is not None:
+            req.extra["trace"] = trace_id
         try:
             # supervisor replays of already-admitted work are exempt from
             # the queue cap, like preemption requeues — the cap must not
@@ -1164,12 +1187,15 @@ class ServeEngine:
         else:
             self.metrics.on_submit(req)
         if self.tracer is not None:
-            self.tracer.request_phase(req.req_id, "queued", args={
-                "prompt_len": req.prompt_len,
-                "max_new_tokens": max_new_tokens,
-            })
+            self.tracer.request_phase(req.req_id, "queued", args=self._targs(
+                req, prompt_len=req.prompt_len,
+                max_new_tokens=max_new_tokens,
+            ))
             if _recovered:
-                self.tracer.request_instant(req.req_id, "recovery-replay")
+                # the LINK instant: a replay/drain continues the same
+                # trace id — merged timelines connect through it
+                self.tracer.request_instant(
+                    req.req_id, "recovery-replay", args=self._targs(req))
         self._requests[req.req_id] = req
         if self.journal is not None and not _recovered:
             # recovered resubmits are re-journaled from recover() AFTER
@@ -1192,9 +1218,18 @@ class ServeEngine:
         on_event: Callable[[Request, str], None] | None = None,
         deadline_s: float | None = None,
         deadline_at: float | None = None,
+        trace_id: str | None = None,
+        lineage: dict | None = None,
     ) -> Request:
         """Resubmit a request that was in flight when a previous engine
         instance died, with its already-delivered tokens teacher-forced.
+
+        ``trace_id`` continues the request's ORIGINAL W3C trace (a
+        replay is a link in the same trace, never a fresh one);
+        ``lineage`` carries the survival counters the canonical request
+        log reports (``replays`` — supervised-restart/journal
+        recoveries including this one, ``drains`` — adoptions by a live
+        peer after a replica died).
 
         This is the evict-requeue discipline applied across an engine
         rebuild: ``generated`` pre-seeds the request, so its first
@@ -1227,11 +1262,18 @@ class ServeEngine:
         req = self.submit(
             prompt_ids, max_new_tokens, request_id=request_id, seed=seed,
             callback=callback, on_event=on_event, deadline_s=deadline_s,
-            _recovered=True,
+            trace_id=trace_id, _recovered=True,
         )
         if deadline_at is not None:
             req.deadline = deadline_at
         req.generated = [int(t) for t in generated]
+        if lineage:
+            # before the journal re-admission below, so a SECOND crash
+            # replays the lineage along with the token state
+            req.extra.update({
+                k: int(v) for k, v in lineage.items()
+                if k in ("replays", "drains")
+            })
         if self.journal is not None:
             self.journal.admit(req, now=self.clock())
         detok = self._detok.get(req.req_id)
@@ -1251,6 +1293,8 @@ class ServeEngine:
         request_id: int,
         generated: list[int] | tuple[int, ...],
         reason: str,
+        trace_id: str | None = None,
+        lineage: dict | None = None,
     ) -> str | None:
         """Terminal bookkeeping for a request that was recovered ALREADY
         complete (every token generated pre-crash; only its finish event
@@ -1269,18 +1313,29 @@ class ServeEngine:
         )
         req.generated = [int(t) for t in generated]
         req.finish_reason = reason
+        if trace_id is not None:
+            req.extra["trace"] = trace_id
+        if lineage:
+            req.extra.update({
+                k: int(v) for k, v in lineage.items()
+                if k in ("replays", "drains")
+            })
         if self.journal is not None:
             self.journal.terminal(request_id, reason)
         if reason == "aborted":
             self.metrics.on_abort(req)
         else:
             self.metrics.on_finish(req)
+        # the canonical log still gets its line (phases empty — the
+        # timestamps died with the old process; the SLO verdict reports
+        # it untimed rather than guessing)
+        self._log_request(req, reason)
         if self.tracer is not None:
             # close whatever span the pre-crash engine left open so the
             # span-vs-metrics parity (finish instants == terminal
             # counters) holds across recoveries too
-            self.tracer.request_end(request_id, reason,
-                                    args={"recovered_terminal": True})
+            self.tracer.request_end(request_id, reason, args=self._targs(
+                req, recovered_terminal=True))
         if self.tokenizer is None or not req.generated:
             return None
         detok = IncrementalDetok(self.tokenizer)
@@ -1317,6 +1372,8 @@ class ServeEngine:
             mesh_plan=self.mesh_plan,
             mesh_devices=self._mesh_devices,
             journal=self.journal,
+            request_log=self.request_log,
+            sentinel=self.sentinel,
         )
         eng.metrics = self.metrics
         eng.decode_degraded = self.decode_degraded
@@ -1338,6 +1395,52 @@ class ServeEngine:
         for name in names:
             setattr(eng, name, getattr(self, name))
         return eng
+
+    def _targs(self, req: Request, **kw: Any) -> dict:
+        """Span args with the request's W3C trace id merged in (when it
+        has one) — what lets ``summarize_trace --merge`` stitch the
+        per-replica fragments of one request back together.  Callers
+        hold the tracer is-None guard; with tracing off this never
+        runs."""
+        tid = req.extra.get("trace")
+        if tid is not None:
+            kw["trace"] = tid
+        return kw
+
+    def _log_request(self, req: Request, reason: str) -> None:
+        """Emit the canonical wide-event line for a terminal request
+        (enqueue only — the request-log writer thread does the IO)."""
+        if self.request_log is None:
+            return
+        tracker = getattr(self.metrics, "slo", None)
+        self.request_log.emit(request_record(
+            req, reason=reason,
+            policy=tracker.policy if tracker is not None else None,
+            clock=self.clock,
+        ))
+
+    def _sentinel_observe(
+        self, phases: tuple[tuple[str, float, float], ...],
+    ) -> None:
+        """Feed one tick's phase slices to the anomaly sentinel; an
+        outlier stamps a trace instant naming the guilty phase and
+        bumps the per-phase anomaly counter."""
+        sent = self.sentinel
+        if sent is None:
+            return
+        outliers = sent.observe(phases)
+        if not outliers:
+            return
+        for o in outliers:
+            self.metrics.on_anomaly(str(o["phase"]))
+        guilty = outliers[0]
+        if self.tracer is not None:
+            self.tracer.instant("anomaly", cat="sentinel", args={
+                "phase": guilty["phase"],
+                "dur_us": round(float(guilty["dur_us"]), 1),
+                "baseline_us": round(float(guilty["baseline_us"]), 1),
+                "tick": sent.ticks,
+            })
 
     def _emit(self, req: Request, token: int) -> None:
         req.generated.append(int(token))
@@ -1390,8 +1493,10 @@ class ServeEngine:
                 # terminal so the replay set stays exact
                 self.journal.end_tick((req,))
                 self.journal.terminal(req.req_id, req.finish_reason)
+            self._log_request(req, req.finish_reason)
             if self.tracer is not None:
-                self.tracer.request_end(req.req_id, req.finish_reason)
+                self.tracer.request_end(req.req_id, req.finish_reason,
+                                        args=self._targs(req))
             self._emit_event(req, req.finish_reason)
             return True
         return False
@@ -1422,8 +1527,10 @@ class ServeEngine:
         if self.journal is not None:
             self.journal.end_tick((req,))
             self.journal.terminal(req.req_id, "aborted")
+        self._log_request(req, "aborted")
         if self.tracer is not None:
-            self.tracer.request_end(req.req_id, "aborted")
+            self.tracer.request_end(req.req_id, "aborted",
+                                    args=self._targs(req))
         self._emit_event(req, "aborted")
         return True
 
@@ -1572,10 +1679,11 @@ class ServeEngine:
             if req.admit_time is None:
                 req.admit_time = t_req
             if self.tracer is not None:
-                self.tracer.request_phase(req.req_id, "prefill", args={
-                    "shared_blocks": req.n_shared_blocks,
-                    "preemptions": req.n_preemptions,
-                })
+                self.tracer.request_phase(
+                    req.req_id, "prefill", args=self._targs(
+                        req, shared_blocks=req.n_shared_blocks,
+                        preemptions=req.n_preemptions,
+                    ))
             self._prefill_request(req)
             req.prefill_s += self.clock() - t_req
             if not self._maybe_finish(req) and self.tracer is not None:
@@ -1647,6 +1755,15 @@ class ServeEngine:
                 "queue_depth": self.scheduler.queue_depth,
                 "admitted": len(admitted),
             })
+            if self.sentinel is not None:
+                # same literal phase tuple the tracer records (R2
+                # recovers its exempt spans from the tick() literal, so
+                # the tuple cannot be hoisted into a shared local)
+                self._sentinel_observe((
+                    ("admission", t0, t1), ("prefill", t1, t2),
+                    ("grow", t2, t3), ("decode_dispatch", t3, t4),
+                    ("host_sync", t4, t5), ("deliver", t5, t6),
+                ))
         return self.scheduler.has_work
 
     # ------------------------------------------------------------------
@@ -1785,10 +1902,11 @@ class ServeEngine:
                 req.admit_time = self.clock()
             self._init_mixed_prefill(req)
             if self.tracer is not None:
-                self.tracer.request_phase(req.req_id, "prefill", args={
-                    "shared_blocks": req.n_shared_blocks,
-                    "preemptions": req.n_preemptions,
-                })
+                self.tracer.request_phase(
+                    req.req_id, "prefill", args=self._targs(
+                        req, shared_blocks=req.n_shared_blocks,
+                        preemptions=req.n_preemptions,
+                    ))
         t1 = self.tracer.now_us() if self.tracer is not None else -1.0
 
         for req in self.scheduler.ensure_decode_blocks():
@@ -1862,6 +1980,14 @@ class ServeEngine:
                 "prefill_tokens": n_prefill_tok,
                 "decode_tokens": n_decode_tok,
             })
+            if self.sentinel is not None:
+                # same literal tuple as the tick() call above (R2's
+                # exempt-span recovery reads the literal there)
+                self._sentinel_observe((
+                    ("admission", t0, t1), ("grow", t1, t2),
+                    ("plan", t2, t3), ("mixed_dispatch", t3, t4),
+                    ("host_sync", t4, t5), ("deliver", t5, t6),
+                ))
         return self.scheduler.has_work
 
     def _dispatch_mixed(self, args: tuple, has_prefill: bool) -> tuple:
@@ -2094,15 +2220,25 @@ class ServeEngine:
         # the journal is suspended with them: warmup's dummy request is
         # compile-only and must not leave admission records a restart
         # would try to replay
+        # ...and the request log: warmup's dummy request is not a real
+        # terminal, so it must not leave a canonical log line
         faults, self.faults = self.faults, None
         tracer, self.tracer = self.tracer, None
         journal, self.journal = self.journal, None
+        request_log, self.request_log = self.request_log, None
+        # the SLO tracker is suspended the same way (the dummy request
+        # must not count as a verdict) and survives _warmup_body's
+        # metrics reset — the fresh ServeMetrics gets it back
+        slo_tracker = getattr(self.metrics, "slo", None)
+        self.metrics.slo = None
         try:
             self._warmup_body(prompt_lens, max_new_tokens)
         finally:
             self.faults = faults
             self.tracer = tracer
             self.journal = journal
+            self.request_log = request_log
+            self.metrics.slo = slo_tracker
 
     def _warmup_body(self, prompt_lens: list[int],
                      max_new_tokens: int) -> None:

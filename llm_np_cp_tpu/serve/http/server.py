@@ -74,6 +74,11 @@ from llm_np_cp_tpu.serve.http.protocol import (
 from llm_np_cp_tpu.serve.http.sse import DONE_SENTINEL, sse_event
 from llm_np_cp_tpu.serve.metrics import ServeMetrics
 from llm_np_cp_tpu.serve.scheduler import QueueFull
+from llm_np_cp_tpu.serve.tracing import (
+    gen_trace_id,
+    make_traceparent,
+    parse_traceparent,
+)
 
 TERMINAL_EVENTS = ("stop", "length", "aborted")
 
@@ -134,6 +139,9 @@ class EngineRunner:
                  restart_window_s: float = 300.0) -> None:
         self.engine = engine
         self.faults = getattr(engine, "faults", None)
+        # which replica this runner is in a fleet (ReplicaRunner sets
+        # it); the canonical request log tags every line with it
+        self.replica_index = 0
         self.request_timeout = request_timeout
         self.idle_poll_s = idle_poll_s
         self.tick_deadline = tick_deadline
@@ -283,10 +291,15 @@ class EngineRunner:
             return
         cb, on_event = self._bridge(gen)
         try:
-            engine.recover(
+            req = engine.recover(
                 rec["prompt"], rec["max_tokens"], request_id=rid,
                 seed=rec["seed"], generated=tokens, callback=cb,
                 on_event=on_event, deadline_at=rec.get("deadline_at"),
+                trace_id=rec.get("trace"),
+                lineage={
+                    "replays": int(rec.get("replays", 0)) + 1,
+                    "drains": int(rec.get("drains", 0)),
+                },
             )
         except Exception as e:  # noqa: BLE001 — per-request fate
             # a request the rebuilt pool cannot re-admit fails alone,
@@ -295,10 +308,14 @@ class EngineRunner:
             print(f"[serve] recovery dropped request {rid}: {e}",
                   file=sys.stderr)
         else:
+            # the request now lives on THIS runner's replica (a drain
+            # adoption moved it) — the canonical log tags it here
+            req.extra["replica"] = self.replica_index
             with self._sup_lock:
                 if gen == self._gen:
                     self._inflight[rid] = dict(
                         rec, tokens=list(tokens),
+                        replays=int(rec.get("replays", 0)) + 1,
                         deltas=list(rec.get("deltas") or
                                     [None] * len(tokens)),
                     )
@@ -315,6 +332,11 @@ class EngineRunner:
         tail = self.engine.finish_recovered(
             rec["prompt"], rec["max_tokens"], request_id=rid,
             generated=rec["tokens"], reason=reason,
+            trace_id=rec.get("trace"),
+            lineage={
+                "replays": int(rec.get("replays", 0)) + 1,
+                "drains": int(rec.get("drains", 0)),
+            },
         )
         if rid in self._live:
             self._push(rid, ("finish", reason, tail))
@@ -333,6 +355,9 @@ class EngineRunner:
                            [None] * len(rec["tokens"])),
             "reason": reason,
             "tail": tail,
+            # a late resume's response still carries the request's
+            # ORIGINAL trace context
+            "trace": rec.get("trace"),
         }
         while len(self._resumable) > 512:
             self._resumable.pop(next(iter(self._resumable)))
@@ -502,6 +527,7 @@ class EngineRunner:
                     payload.prompt_ids, payload.max_tokens,
                     request_id=rid, seed=payload.seed, callback=cb,
                     on_event=on_event, deadline_s=deadline,
+                    trace_id=getattr(payload, "trace_id", None),
                 )
             except QueueFull:
                 self._push(rid, ("rejected", 1))
@@ -510,6 +536,11 @@ class EngineRunner:
                 self._push(rid, ("error", str(e)))
                 self._live.pop(rid, None)
             else:
+                # route verdict + replica tag for the canonical request
+                # log (the router filled payload.route_spilled)
+                req.extra["replica"] = self.replica_index
+                if getattr(payload, "route_spilled", False):
+                    req.extra["spilled"] = True
                 self._inflight[rid] = {
                     "rid": rid,
                     "prompt": payload.prompt_ids,
@@ -520,6 +551,12 @@ class EngineRunner:
                     # remaining budget instead of granting a fresh
                     # window per crash
                     "deadline_at": req.deadline,
+                    # trace continuity + survival lineage: a restart
+                    # replay or a drain-to-peer continues the SAME
+                    # trace, with its replays/drains counters
+                    "trace": req.extra.get("trace"),
+                    "replays": 0,
+                    "drains": 0,
                     "tokens": [],
                     # parallel text deltas, so a Last-Event-ID resume
                     # replays the exact text the stream would have
@@ -582,7 +619,10 @@ class EngineRunner:
             return
         self._live[rid] = (loop, aq)
         self.journal_resumed += 1
-        self._push(rid, ("accepted",))
+        # the accepted verdict carries the stream's ORIGINAL trace id,
+        # so the resumed response can emit the same traceparent the
+        # first response did — a reconnect continues the trace
+        self._push(rid, ("accepted", src.get("trace")))
         toks = src["tokens"][last_idx:]
         deltas = src.get("deltas") or []
         deltas = deltas[last_idx:]
@@ -717,6 +757,13 @@ class EngineRunner:
         old.metrics = ServeMetrics(clock=old.clock)
         old.tracer = None
         old.journal = None
+        # ...and the request log: a zombie's stale terminal lines must
+        # not interleave with the rebuilt engine's canonical log — and
+        # the sentinel: clone_fresh SHARES it (engine-thread-only
+        # state), so a zombie tick observing concurrently with the
+        # rebuilt engine would corrupt the EWMA baselines
+        old.request_log = None
+        old.sentinel = None
         with self._sup_lock:
             if gen != self._gen:
                 # superseded DURING the rebuild (it wedged long enough
@@ -1022,6 +1069,8 @@ class HttpServer:
                 writer, 200, self._render_metrics().encode(),
                 content_type="text/plain; version=0.0.4; charset=utf-8",
             )
+        elif method == "GET" and path == "/debug/slo":
+            await self._respond_slo(writer)
         elif method == "GET" and path == "/debug/trace":
             tracer = self.tracer
             if tracer is None:
@@ -1149,6 +1198,30 @@ class HttpServer:
             **journal_gauges,
         })
 
+    async def _respond_slo(self, writer: asyncio.StreamWriter) -> None:
+        """``GET /debug/slo``: the fleet's SLO accounting as one JSON —
+        attainment, goodput, burn rates, summed across replicas with a
+        per-replica breakdown.  404 + hint when no policy is attached
+        (the ``/debug/trace`` discipline)."""
+        from llm_np_cp_tpu.serve.slo import aggregate_slo
+
+        replicas = getattr(self.runner, "replicas", None)
+        runners = replicas if replicas is not None else [self.runner]
+        trackers = [
+            getattr(r.engine.metrics, "slo", None) for r in runners
+        ]
+        if not any(t is not None for t in trackers):
+            await self._respond_error(writer, HTTPError(
+                404, "SLO accounting is off; start the server with "
+                "--slo-ttft/--slo-tpot"))
+            return
+        body = aggregate_slo(trackers)
+        if replicas is not None:
+            body["replicas"] = [
+                t.snapshot() if t is not None else None for t in trackers
+            ]
+        await self._respond(writer, 200, json.dumps(body).encode())
+
     # ------------------------------------------------------------------
     async def _completions(self, reader: asyncio.StreamReader,
                            writer: asyncio.StreamWriter,
@@ -1195,6 +1268,13 @@ class HttpServer:
             await self._respond_error(writer, e)
             return
 
+        # W3C trace context: continue the caller's trace or start one —
+        # every request has ONE trace id from here through routing,
+        # journal replay, and drain-to-peer (a malformed header means a
+        # fresh trace, never a 400)
+        ctx = parse_traceparent(headers.get("traceparent"))
+        payload.trace_id = ctx[0] if ctx is not None else gen_trace_id()
+
         loop = asyncio.get_running_loop()
         aq: asyncio.Queue = asyncio.Queue()
         rid = self.runner.next_rid()
@@ -1207,7 +1287,8 @@ class HttpServer:
             # trace epoch
             tracer.async_begin(rid, "http",
                                ts_us=t_accept if t_accept >= 0.0 else None,
-                               args={"stream": bool(payload.stream)})
+                               args={"stream": bool(payload.stream),
+                                     "trace": payload.trace_id})
         try:
             await self._completions_inner(
                 reader, writer, payload, rid, loop, aq)
@@ -1238,6 +1319,12 @@ class HttpServer:
             ))
             return
         created = int(time.time())
+        # emit the trace context back: the client (or a proxy) can join
+        # its own telemetry to this server's spans/logs by trace id
+        tp = getattr(payload, "trace_id", None)
+        resp_headers = (
+            (("traceparent", make_traceparent(tp)),) if tp else ()
+        )
         # Disconnect watch: drain (and DISCARD, bounded-memory) anything
         # else the client sends — we are Connection: close, so stray
         # bytes are pipelining we don't support — and complete only at
@@ -1249,10 +1336,12 @@ class HttpServer:
         try:
             if payload.stream:
                 await self._stream_response(
-                    writer, aq, monitor, rid, payload, created)
+                    writer, aq, monitor, rid, payload, created,
+                    extra_headers=resp_headers)
             else:
                 await self._unary_response(
-                    writer, aq, monitor, rid, payload, created)
+                    writer, aq, monitor, rid, payload, created,
+                    extra_headers=resp_headers)
         finally:
             monitor.cancel()
             with contextlib.suppress(asyncio.CancelledError):
@@ -1305,12 +1394,19 @@ class HttpServer:
                 return
             created = int(time.time())
             payload = _ResumeEcho(echo_model)
+            # the attach verdict carries the original trace id (when
+            # the ledger/parked entry kept one): the resumed stream
+            # emits the SAME traceparent as the first response
+            tp = verdict[1] if len(verdict) > 1 else None
+            resume_headers = (
+                (("traceparent", make_traceparent(tp)),) if tp else ()
+            )
             monitor = asyncio.ensure_future(
                 self._watch_disconnect(reader))
             try:
                 await self._stream_response(
                     writer, aq, monitor, rid, payload, created,
-                    start_idx=last_idx)
+                    start_idx=last_idx, extra_headers=resume_headers)
             finally:
                 monitor.cancel()
                 with contextlib.suppress(asyncio.CancelledError):
@@ -1341,18 +1437,22 @@ class HttpServer:
         return None
 
     async def _stream_response(self, writer, aq, monitor, rid,
-                               payload, created, start_idx: int = 0) -> None:
+                               payload, created, start_idx: int = 0,
+                               extra_headers: tuple = ()) -> None:
         # delivered-token index, carried as the SSE event id on every
         # token frame: a client that reconnects with Last-Event-ID = the
         # last id it saw gets exactly the tokens it is missing
         idx = start_idx
+        head = (
+            "HTTP/1.1 200 OK\r\n"
+            "Content-Type: text/event-stream\r\n"
+            "Cache-Control: no-cache\r\n"
+            "Connection: close\r\n"
+        )
+        for key, value in extra_headers:
+            head += f"{key}: {value}\r\n"
         try:
-            writer.write(
-                b"HTTP/1.1 200 OK\r\n"
-                b"Content-Type: text/event-stream\r\n"
-                b"Cache-Control: no-cache\r\n"
-                b"Connection: close\r\n\r\n"
-            )
+            writer.write(head.encode() + b"\r\n")
             await writer.drain()
         except (ConnectionResetError, BrokenPipeError, OSError):
             # gone before the first byte: the request must not keep its
@@ -1394,7 +1494,8 @@ class HttpServer:
                 return
 
     async def _unary_response(self, writer, aq, monitor, rid,
-                              payload, created) -> None:
+                              payload, created,
+                              extra_headers: tuple = ()) -> None:
         token_ids: list[int] = []
         text_parts: list[str] = []
         while True:
@@ -1417,7 +1518,8 @@ class HttpServer:
             finish_reason=reason,
             prompt_tokens=int(payload.prompt_ids.size),
         )).encode()
-        await self._respond(writer, 200, body)
+        await self._respond(writer, 200, body,
+                            extra_headers=extra_headers)
 
     # ------------------------------------------------------------------
     async def _respond(self, writer: asyncio.StreamWriter, status: int,

@@ -134,6 +134,12 @@ def _apply(state: dict[int, dict], rec: dict) -> int | None:
             "seed": int(rec.get("seed", 0)),
             "deadline_wall": rec.get("deadline_wall"),
             "tokens": list(rec.get("tokens", ())),
+            # trace continuity + survival lineage: a replay continues
+            # the request's W3C trace and its replays/drains counters
+            # (the canonical request log reports them)
+            "trace": rec.get("trace"),
+            "replays": int(rec.get("replays", 0)),
+            "drains": int(rec.get("drains", 0)),
         }
     elif t == "wm":
         for rid, n, toks in rec["rows"]:
@@ -183,12 +189,21 @@ class RequestJournal:
         clock: Callable[[], float] = time.perf_counter,
         compact_bytes: int = 4 << 20,
         fsync: bool = True,
+        sync_admissions: bool = False,
         fault_injector: Any = None,
     ) -> None:
         self.path = path
         self.clock = clock
         self.compact_bytes = compact_bytes
         self.fsync = fsync
+        # strict mode (`serve --journal-sync admission`): ``admit``
+        # blocks on a writer-thread flush barrier, so the admission
+        # record is written AND fsynced before the 202/stream starts —
+        # closing the async-fsync window where an admission accepted
+        # milliseconds before a kill -9 could vanish (clients retry, so
+        # the default async mode tolerates it; strict mode is for
+        # operators who would rather pay one fsync of admission latency)
+        self.sync_admissions = sync_admissions
         self.faults = fault_injector
         # -- open: scan the existing file, truncate the torn tail, note
         # the unterminated state for the caller to replay (single-
@@ -259,7 +274,7 @@ class RequestJournal:
         if req.deadline is not None:
             deadline_wall = time.time() + (req.deadline - now)
         self._mark[req.req_id] = len(req.generated)
-        self._enqueue({
+        rec = {
             "t": "adm",
             "rid": req.req_id,
             "prompt": [int(x) for x in req.prompt],
@@ -267,7 +282,23 @@ class RequestJournal:
             "seed": int(req.seed),
             "deadline_wall": deadline_wall,
             "tokens": [int(x) for x in req.generated],
-        })
+        }
+        # trace id + survival lineage ride the admission record so a
+        # post-restart replay continues the SAME trace (and the request
+        # log's replays/drains counters survive a second crash)
+        trace = req.extra.get("trace")
+        if trace is not None:
+            rec["trace"] = trace
+        for key in ("replays", "drains"):
+            val = req.extra.get(key)
+            if val:
+                rec[key] = int(val)
+        self._enqueue(rec)
+        if self.sync_admissions:
+            # block the enqueuing (engine) thread until the writer has
+            # written AND fsynced this admission; failure degrades
+            # (counted), never blocks admission forever
+            self.flush(timeout=10.0)
 
     def end_tick(self, requests: Any) -> None:
         """One watermark record for the whole tick (batched per tick,
@@ -414,14 +445,22 @@ class RequestJournal:
                                 "wall": time.time()}))
                 for rid in sorted(self._wlive):
                     ent = self._wlive[rid]
-                    f.write(_frame({
+                    rec = {
                         "t": "adm", "rid": rid,
                         "prompt": ent["prompt"],
                         "max_tokens": ent["max_tokens"],
                         "seed": ent["seed"],
                         "deadline_wall": ent.get("deadline_wall"),
                         "tokens": ent["tokens"],
-                    }))
+                    }
+                    # trace/lineage survive compaction, or a compacted-
+                    # then-replayed request would start a fresh trace
+                    if ent.get("trace") is not None:
+                        rec["trace"] = ent["trace"]
+                    for key in ("replays", "drains"):
+                        if ent.get(key):
+                            rec[key] = ent[key]
+                    f.write(_frame(rec))
                 f.flush()
                 if self.fsync:
                     os.fsync(f.fileno())

@@ -85,9 +85,19 @@ def _pcts(values: list[float], name: str) -> dict[str, float]:
 
 class ServeMetrics:
     def __init__(self, clock=time.perf_counter,
-                 max_samples: int | None = None) -> None:
+                 max_samples: int | None = None,
+                 slo: Any = None) -> None:
         self.clock = clock
         self._lock = threading.Lock()
+        # SLO goodput accounting (serve/slo.SLOTracker): judged per
+        # request at terminal time inside _record_latencies, under this
+        # lock.  None (the default) = a single is-None check per
+        # terminal — the zero-overhead hook discipline
+        self.slo = slo
+        # tick anomaly sentinel verdicts (serve/slo.TickSentinel via
+        # ServeEngine._sentinel_observe): per-phase outlier counts,
+        # exported as llm_serve_anomaly_ticks_total{phase=}
+        self.anomaly_ticks: Counter[str] = Counter()
         # bounded-retention mode for long-running servers: None (bench/
         # test traces — exact full-trace percentiles) keeps every sample;
         # an int caps each value list, dropping the oldest half on
@@ -180,6 +190,11 @@ class ServeMetrics:
                          self.active_slots, self.kv_bytes_tick):
                 self._trim(vals)
 
+    def on_anomaly(self, phase: str) -> None:
+        """The tick sentinel named ``phase`` as an outlier this tick."""
+        with self._lock:
+            self.anomaly_ticks[phase] += 1
+
     def on_prefix(self, *, requested: int, hits: int) -> None:
         """One prefill's prefix-cache outcome: ``requested`` shareable
         prompt blocks were looked up, ``hits`` were reused."""
@@ -207,6 +222,11 @@ class ServeMetrics:
 
     def _record_latencies(self, req: Request) -> None:
         # caller holds the lock
+        if self.slo is not None:
+            # every terminal gets an SLO verdict (ok / miss / untimed)
+            # — aborts are misses, recovered-without-timestamps are
+            # untimed, see serve/slo.SLOPolicy.verdict
+            self.slo.observe(req)
         if req.submit_time is not None and req.first_token_time is not None:
             # realtime replay records the wall arrival, so TTFT includes
             # the wait before the tick loop noticed the request; the
@@ -268,6 +288,10 @@ class ServeMetrics:
             prefix_hit = self.prefix_blocks_hit
             out["mixed_prefill_tokens"] = self.mixed_prefill_tokens
             out["mixed_decode_tokens"] = self.mixed_decode_tokens
+            if self.slo is not None:
+                out.update(self.slo.snapshot())
+            if self.anomaly_ticks:
+                out["anomaly_ticks"] = dict(self.anomaly_ticks)
         out.update(_pcts(ttft, "ttft_s"))
         out.update(_pcts(decode, "decode_tok_s"))
         out.update(_pcts(qwait, "queue_wait_s"))
@@ -379,6 +403,42 @@ class ServeMetrics:
         emit("throughput_tok_s", "gauge",
              "Generated tokens per second over the traffic span",
              [("", s["throughput_tok_s"])])
+        # -- SLO goodput accounting (only when a policy is attached:
+        # series that are always 0-with-no-policy would read as "a
+        # perfect SLO" on a dashboard that aggregates the fleet)
+        if "slo_ok" in s:
+            emit("goodput_tok_s", "gauge",
+                 "SLO-attaining tokens per second over the traffic span "
+                 "(tokens of requests that met every latency target)",
+                 [("", s["goodput_tok_s"])])
+            if "slo_attainment" in s:
+                # omitted (not defaulted) until a timed verdict exists:
+                # a fabricated 1.0 would read as a perfect SLO
+                emit("slo_attainment", "gauge",
+                     "Fraction of timed terminal requests meeting the "
+                     "SLO",
+                     [("", s["slo_attainment"])])
+            emit("slo_requests_total", "counter",
+                 "Terminal requests by SLO verdict (untimed = recovered "
+                 "with no surviving timestamps; excluded from attainment)",
+                 [('{verdict="ok"}', s["slo_ok"]),
+                  ('{verdict="miss"}', s["slo_miss"]),
+                  ('{verdict="untimed"}', s["slo_untimed"])])
+            burn = [
+                (f'{{window="{k[len("slo_burn_rate_"):]}"}}', s[k])
+                for k in sorted(s) if k.startswith("slo_burn_rate_")
+            ]
+            if burn:
+                emit("slo_burn_rate", "gauge",
+                     "Error-budget burn rate per window (observed miss "
+                     "rate / budgeted miss rate; >1 = overspending)",
+                     burn)
+        if s.get("anomaly_ticks"):
+            emit("anomaly_ticks_total", "counter",
+                 "Ticks where the sentinel flagged this phase as an "
+                 "outlier vs its rolling baseline",
+                 [(f'{{phase="{p}"}}', n)
+                  for p, n in sorted(s["anomaly_ticks"].items())])
         # -- real histograms: cumulative _bucket/_sum/_count from the
         # incrementally-maintained counters (exact forever, unlike the
         # trimmed percentile windows; aggregable across replicas)

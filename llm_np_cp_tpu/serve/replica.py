@@ -231,10 +231,13 @@ class ReplicaSet:
                on_event: Callable | None = None,
                deadline_s: float | None = None,
                arrival_time: float | None = None,
+               trace_id: str | None = None,
                replica: int | None = None) -> Request:
         """Route (or pin, via ``replica=``) and submit.  The returned
-        Request carries its replica in ``extra['replica']``."""
+        Request carries its replica in ``extra['replica']`` and the
+        router's spill verdict in ``extra['spilled']``."""
         chain = None
+        spilled = False
         if replica is None:
             key, chain = self.router.affinity_chain(prompt_ids)
             replica, spilled = self.router.route(
@@ -248,8 +251,16 @@ class ReplicaSet:
         req = self.engines[replica].submit(
             prompt_ids, max_new_tokens, request_id=rid, seed=seed,
             callback=callback, on_event=on_event, deadline_s=deadline_s,
-            arrival_time=arrival_time,
+            arrival_time=arrival_time, trace_id=trace_id,
         )
+        if spilled:
+            req.extra["spilled"] = True
+        tracer = getattr(self.engines[replica], "tracer", None)
+        if tracer is not None:
+            tracer.instant("route", cat="router", args={
+                "rid": rid, "replica": replica, "spilled": spilled,
+                "trace": req.extra.get("trace"),
+            })
         if chain is not None:
             # hand the router's hash chain to the engine's admission
             # plan — same content, same width, same chain — so the
@@ -362,6 +373,14 @@ class ReplicaSet:
         out["prefix_blocks_hit"] = hit
         if req:
             out["prefix_hit_rate"] = hit / req
+        # fleet SLO accounting: summed verdicts, burn rates recomputed
+        # from summed window totals (serve/slo.aggregate_slo)
+        from llm_np_cp_tpu.serve.slo import aggregate_slo
+
+        agg = aggregate_slo(
+            [getattr(e.metrics, "slo", None) for e in self.engines]
+        )
+        out.update({k: v for k, v in agg.items() if k != "policy"})
         return out
 
     # ------------------------------------------------------------------
@@ -419,6 +438,8 @@ class ReplicaRunner:
             # unterminated streams to the peers the router re-homes its
             # prefixes to, instead of abort-flushing them
             runner.on_terminal_crash = partial(self._drain_dead, i)
+            # request-log lines tag which replica served the request
+            runner.replica_index = i
         e0 = engines[0]
         self.router = PrefixRouter(
             len(engines), block_size=e0.block_size,
@@ -532,9 +553,20 @@ class ReplicaRunner:
         key = self.router.affinity_key(payload.prompt_ids)
         loads = [r.inflight for r in self.replicas]
         qd = [r.engine.scheduler.queue_depth for r in self.replicas]
-        idx, _spilled = self.router.route(
+        idx, spilled = self.router.route(
             key, loads=loads, queue_depths=qd, alive=alive,
         )
+        # the routing verdict rides the payload into the engine thread:
+        # the canonical request log reports route + spill per request
+        payload.route_spilled = spilled
+        tracer = getattr(self.engine, "tracer", None)
+        if tracer is not None:
+            # routing decisions are part of the request's trace: the
+            # instant carries the SAME trace id the engine spans will
+            tracer.instant("route", cat="router", args={
+                "rid": rid, "replica": idx, "spilled": spilled,
+                "trace": getattr(payload, "trace_id", None),
+            })
         if len(self._owner) > 64 + 4 * max(self.inflight, 1):
             self._owner = {
                 r: i for r, i in self._owner.items()
@@ -597,6 +629,7 @@ class ReplicaRunner:
         adopted: set[int] = set()
         loads = [r.inflight for r in self.replicas]
         qd = [r.engine.scheduler.queue_depth for r in self.replicas]
+        tracer = getattr(dead.engine, "tracer", None)
         for rec in replay:
             rid = rec["rid"]
             key = self.router.affinity_key(rec["prompt"])
@@ -606,6 +639,18 @@ class ReplicaRunner:
             if ent is not None:
                 self.replicas[idx]._live[rid] = ent
             self._owner[rid] = idx
+            # the adoption is a survival event: bump the drain counter
+            # (it rides the peer's recovery re-admission into its
+            # journal, so a later restart still reports it)
+            rec = dict(rec, drains=int(rec.get("drains", 0)) + 1)
+            if tracer is not None:
+                # the LINK instant on the request's track: the merged
+                # timeline connects the dead replica's spans to the
+                # peer's continuation through the shared trace id
+                tracer.request_instant(rid, "drain-to-peer", args={
+                    "trace": rec.get("trace"),
+                    "from_replica": dead_idx, "to_replica": idx,
+                })
             self.replicas[idx]._cmds.put(("recover", rec))
             if dead_journal is not None:
                 dead_journal.terminal(rid, "drained")

@@ -45,6 +45,7 @@ from __future__ import annotations
 
 import json
 import os
+import re
 import threading
 import time
 from collections import deque
@@ -74,6 +75,50 @@ MIXED_TICK_PHASES = (
     "deliver",
 )
 
+# ----------------------------------------------------------------------
+# W3C trace context (the `traceparent` header): the ONE request identity
+# that survives the fleet.  A request routed by the PrefixRouter, killed
+# with its process, journal-replayed, and drained to a peer replica
+# keeps the SAME 32-hex trace id through every hop — span args carry it,
+# so tools/summarize_trace.py --merge can stitch per-replica/per-process
+# trace files back into one request-ordered timeline.
+# Format: `00-<32 hex trace id>-<16 hex parent span id>-<2 hex flags>`.
+# ----------------------------------------------------------------------
+_TRACEPARENT_RE = re.compile(
+    r"^([0-9a-f]{2})-([0-9a-f]{32})-([0-9a-f]{16})-([0-9a-f]{2})$"
+)
+
+
+def gen_trace_id() -> str:
+    return os.urandom(16).hex()
+
+
+def gen_span_id() -> str:
+    return os.urandom(8).hex()
+
+
+def parse_traceparent(header: str | None) -> tuple[str, str] | None:
+    """``traceparent`` header → ``(trace_id, parent_span_id)``, or None
+    when absent/malformed (a bad header means a FRESH trace, never a
+    400 — trace context must not be able to fail a request)."""
+    if not header:
+        return None
+    m = _TRACEPARENT_RE.match(header.strip().lower())
+    if m is None:
+        return None
+    version, trace_id, parent_id, _flags = m.groups()
+    if version == "ff":  # forbidden version
+        return None
+    if trace_id == "0" * 32 or parent_id == "0" * 16:
+        return None  # all-zero ids are invalid per spec
+    return trace_id, parent_id
+
+
+def make_traceparent(trace_id: str, span_id: str | None = None) -> str:
+    """Render the header this server emits back (sampled flag set —
+    we recorded the request, whatever upstream decided)."""
+    return f"00-{trace_id}-{span_id or gen_span_id()}-01"
+
 
 class TraceRecorder:
     def __init__(
@@ -87,6 +132,11 @@ class TraceRecorder:
         self.clock = clock
         self.ring = ring
         self._t0 = clock()
+        # wall-clock anchor of the trace epoch: per-process perf_counter
+        # timestamps are incommensurable across replicas/restarts, so
+        # --merge rebases each file's events by its anchor before
+        # stitching per-replica timelines together
+        self.wall_epoch = time.time()
         self._pid = os.getpid()
         self._lock = threading.Lock()
         self._events: deque | list = (
@@ -259,7 +309,10 @@ class TraceRecorder:
         return {
             "traceEvents": self.events(),
             "displayTimeUnit": "ms",
-            "otherData": {"dropped_events": self.dropped},
+            "otherData": {
+                "dropped_events": self.dropped,
+                "wall_epoch": self.wall_epoch,
+            },
         }
 
     def dump(self, path: str) -> int:

@@ -1,0 +1,28 @@
+"""R6 bite fixture: jnp.asarray/jnp.array of fresh Python scalars in
+engine tick paths (dtype drift = silent retrace).  Parsed, never
+imported."""
+
+
+class Engine:
+    def step(self):
+        t0 = self.tracer.now_us() if self.tracer is not None else -1.0
+        n = self.scheduler.queue_depth
+        ok = jnp.asarray(self.tables)              # not a scalar: fine
+        pinned = jnp.asarray(7, dtype=jnp.int32)   # dtype pinned: fine
+        np_typed = jnp.asarray(np.int32(n))        # concrete np dtype: fine
+        named = jnp.asarray(n + 1)                 # Name operand: may be an array
+        bad_lit = jnp.asarray(7)  # BITE
+        bad_cast = jnp.array(float(n))  # BITE
+        bad_arith = jnp.asarray(1 + int(n) * 2)  # BITE
+        self._grow()
+        if self.tracer is not None:
+            self.tracer.tick(t0, (("admission", t0, t0),), args={})
+        return ok, pinned, np_typed, named, bad_lit, bad_cast, bad_arith
+
+    def _grow(self):
+        # reached transitively from the tick method above
+        return jnp.asarray(int(self.block_size))  # BITE
+
+    def build_step(self):
+        # NOT a tick path: one-time builders may asarray scalars freely
+        return jnp.asarray(0)

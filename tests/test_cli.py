@@ -523,6 +523,36 @@ def test_cli_serve_bench_trace_out_writes_valid_trace(fake_load, capsys,
         cli.run(["serve-bench", "--trace-ring=-1"])
 
 
+def test_cli_serve_bench_observability_flags(fake_load, capsys, tmp_path):
+    """The PR-10 fleet observability flags end to end on serve-bench:
+    SLO goodput accounting rides the snapshot and the printed summary,
+    the canonical request log gets one line per request (with trace id
+    and SLO verdict), and the tick sentinel implies tracing."""
+    from llm_np_cp_tpu.serve import read_request_log
+
+    rl = tmp_path / "requests.jsonl"
+    out = cli.run([
+        "serve-bench", "--requests=4", "--rate=50", "--prompt-len=8",
+        "--max-tokens=3", "--slots=2", "--block-size=8", "--seed=1",
+        "--slo-ttft=30", "--slo-tpot=30", f"--request-log={rl}",
+        "--tick-sentinel",
+    ])
+    printed = capsys.readouterr().out
+    assert "SLO accounting ACTIVE" in printed
+    assert "request log ACTIVE" in printed
+    assert "tick sentinel ACTIVE" in printed
+    assert "tracing ACTIVE" in printed  # implied by --tick-sentinel
+    assert "slo: attainment" in out
+    lines = read_request_log(str(rl))
+    assert len(lines) == 4  # warmup's dummy request is NOT in there
+    assert all(ln["trace"] and "slo" in ln for ln in lines)
+    assert all(ln["reason"] == "length" for ln in lines)
+    with pytest.raises(SystemExit, match="slo-target"):
+        cli.run(["serve-bench", "--slo-target=1.5"])
+    with pytest.raises(SystemExit, match="slo-ttft"):
+        cli.run(["serve-bench", "--slo-ttft=-1"])
+
+
 def test_cli_serve_bench_rejects_paged_when_probe_fails(fake_load, monkeypatch):
     """An EXPLICIT --attn-impl paged must die with an actionable message
     when Mosaic rejects the kernel — not a Pallas traceback; auto falls

@@ -1,0 +1,606 @@
+"""Fleet observability plane (serve/slo.py + trace propagation +
+canonical request log + tick sentinel).
+
+The contracts being pinned: SLO verdicts are judged per request at
+terminal time (aborts are misses, timestamp-less recoveries are
+untimed), burn rates come from bucketed windows whose math is exact to
+bucket granularity, goodput/attainment ride the metrics snapshot and
+the Prometheus scrape, the canonical request log agrees with metrics by
+construction, trace ids survive routing / journal replay / drain-to-
+peer (the merged per-replica timeline is ONE connected lifecycle per
+request), the sentinel names the guilty phase, the strict journal mode
+fsyncs admissions synchronously, and none of it adds a jit recompile.
+"""
+
+import asyncio
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from llm_np_cp_tpu.config import tiny_config
+from llm_np_cp_tpu.generate import Generator
+from llm_np_cp_tpu.models.transformer import init_params
+from llm_np_cp_tpu.ops.sampling import Sampler
+from llm_np_cp_tpu.serve import (
+    RequestJournal,
+    RequestLog,
+    ServeEngine,
+    ServeMetrics,
+    SLOPolicy,
+    SLOTracker,
+    TickSentinel,
+    TraceRecorder,
+    read_request_log,
+    scan_journal,
+)
+from llm_np_cp_tpu.serve.replica import ReplicaRunner
+from llm_np_cp_tpu.serve.request_log import request_record
+from llm_np_cp_tpu.serve.scheduler import Request
+from llm_np_cp_tpu.serve.slo import RollingWindow, aggregate_slo
+from llm_np_cp_tpu.serve.tracing import (
+    gen_trace_id,
+    make_traceparent,
+    parse_traceparent,
+)
+from tools.summarize_trace import merge_traces, request_timelines
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    cfg = tiny_config("llama")
+    params = init_params(jax.random.PRNGKey(0), cfg, dtype=jnp.float32)
+    return cfg, params
+
+
+def _engine(cfg, params, **kw):
+    kw.setdefault("max_slots", 2)
+    kw.setdefault("num_blocks", 32)
+    kw.setdefault("block_size", 8)
+    kw.setdefault("max_seq_len", 64)
+    kw.setdefault("cache_dtype", jnp.float32)
+    return ServeEngine(params, cfg, sampler=Sampler(kind="greedy"), **kw)
+
+
+def _offline(cfg, params, prompt, max_tokens):
+    gen = Generator(params, cfg, sampler=Sampler(kind="greedy"),
+                    cache_dtype=jnp.float32)
+    res = gen.generate_ragged([np.asarray(prompt, np.int32)], max_tokens)
+    return [int(t) for t in np.asarray(res.tokens)[0][:max_tokens]]
+
+
+def _req(rid=0, *, submit=None, admit=None, first=None, finish=None,
+         generated=(), reason="length", extra=None):
+    req = Request(req_id=rid, prompt=np.asarray([1, 2, 3], np.int32),
+                  max_new_tokens=max(len(generated), 1))
+    req.max_new_tokens = max(len(generated), 1)
+    req.generated = list(generated)
+    req.submit_time = submit
+    req.admit_time = admit
+    req.first_token_time = first
+    req.finish_time = finish
+    req.finish_reason = reason
+    if extra:
+        req.extra.update(extra)
+    return req
+
+
+# ---------------------------------------------------------------------------
+# W3C trace context
+# ---------------------------------------------------------------------------
+
+def test_traceparent_roundtrip_and_rejects():
+    tid = gen_trace_id()
+    header = make_traceparent(tid)
+    parsed = parse_traceparent(header)
+    assert parsed is not None and parsed[0] == tid
+    # tolerated inputs: case + whitespace
+    assert parse_traceparent("  " + header.upper() + " ")[0] == tid
+    # rejected: malformed, zero ids, forbidden version — all mean
+    # "start a fresh trace", never an error
+    for bad in (None, "", "garbage", "00-zz-11-01",
+                f"00-{'0' * 32}-{'1' * 16}-01",
+                f"00-{'1' * 32}-{'0' * 16}-01",
+                f"ff-{'1' * 32}-{'1' * 16}-01"):
+        assert parse_traceparent(bad) is None, bad
+
+
+# ---------------------------------------------------------------------------
+# Burn-rate window math
+# ---------------------------------------------------------------------------
+
+def test_rolling_window_bucket_math():
+    w = RollingWindow(30.0, 3)  # 10s buckets
+    w.add(1.0, True)
+    w.add(11.0, False)
+    w.add(21.0, False)
+    assert w.totals(25.0) == (3, 2)
+    # t=35: the window [5, 35] has dropped the t=1 bucket
+    assert w.totals(35.0) == (2, 2)
+    # slot reuse: t=31 lands in the slot t=1 occupied, resetting it
+    w.add(31.0, True)
+    assert w.totals(35.0) == (3, 2)
+    # far future: everything expired
+    assert w.totals(500.0) == (0, 0)
+
+
+def test_burn_rate_windows_and_aggregate():
+    t = [0.0]
+    clock = lambda: t[0]  # noqa: E731
+    policy = SLOPolicy(ttft_s=1.0, target=0.9)  # 10% error budget
+    tr = SLOTracker(policy, clock=clock,
+                    windows=(("5m", 300.0, 30), ("1h", 3600.0, 60)))
+    # 10 requests, 2 misses → miss rate 0.2, burn = 0.2 / 0.1 = 2.0
+    for i in range(10):
+        t[0] = float(i)
+        ok = i >= 2
+        tr.observe(_req(i, submit=0.0, first=0.1 if ok else 5.0,
+                        finish=1.0, generated=[1]))
+    assert tr.n_ok == 8 and tr.n_miss == 2
+    assert tr.burn_rate("5m", now=10.0) == pytest.approx(2.0)
+    assert tr.burn_rate("1h", now=10.0) == pytest.approx(2.0)
+    # the 5m window forgets the misses; the 1h window still sees them
+    assert tr.burn_rate("5m", now=400.0) == 0.0
+    assert tr.burn_rate("1h", now=400.0) == pytest.approx(2.0)
+    snap = tr.snapshot(now=10.0)
+    assert snap["slo_attainment"] == pytest.approx(0.8)
+    assert snap["slo_burn_rate_5m"] == pytest.approx(2.0)
+    # aggregate: summed counters, burn from summed window totals
+    tr2 = SLOTracker(policy, clock=clock,
+                     windows=(("5m", 300.0, 30), ("1h", 3600.0, 60)))
+    t[0] = 10.0
+    tr2.observe(_req(99, submit=0.0, first=0.1, finish=1.0,
+                     generated=[1, 2]))
+    agg = aggregate_slo([tr, tr2, None])
+    assert agg["slo_ok"] == 9 and agg["slo_miss"] == 2
+    assert agg["slo_attainment"] == pytest.approx(9 / 11)
+
+
+# ---------------------------------------------------------------------------
+# SLO verdicts: abort / evict / recovery semantics
+# ---------------------------------------------------------------------------
+
+def test_slo_verdicts():
+    policy = SLOPolicy(ttft_s=1.0, tpot_s=0.5)
+    # fast request: both targets hold
+    v = policy.verdict(_req(1, submit=0.0, first=0.5, finish=1.4,
+                            generated=[1, 2, 3]))
+    assert v.ok and v.timed and v.ttft_ok and v.tpot_ok
+    # slow first token: ttft miss even though tpot holds
+    v = policy.verdict(_req(2, submit=0.0, first=2.0, finish=2.2,
+                            generated=[1, 2, 3]))
+    assert not v.ok and v.ttft_ok is False and v.tpot_ok is True
+    # slow decode cadence: tpot miss
+    v = policy.verdict(_req(3, submit=0.0, first=0.5, finish=4.5,
+                            generated=[1, 2, 3]))
+    assert not v.ok and v.ttft_ok is True and v.tpot_ok is False
+    # aborted: always a miss, even with great latencies
+    v = policy.verdict(_req(4, submit=0.0, first=0.1, finish=0.2,
+                            generated=[1, 2], reason="aborted"))
+    assert not v.ok
+    tr_ab = SLOTracker(policy)
+    tr_ab.observe(_req(4, reason="aborted"))  # even untimed: a miss
+    assert tr_ab.n_miss == 1 and tr_ab.n_untimed == 0
+    # realtime arrivals: TTFT bases at the wall arrival (ServeMetrics
+    # parity), so queue wait before the tick loop noticed counts
+    v = policy.verdict(_req(5, submit=10.0, first=10.4, finish=10.6,
+                            generated=[1], extra={"arrival_wall": 8.0}))
+    assert v.ttft_ok is False  # 2.4s from arrival, not 0.4s from submit
+    # recovered terminal with no surviving timestamps: untimed, not
+    # guessed (excluded from attainment)
+    v = policy.verdict(_req(6, generated=[1, 2]))
+    assert not v.timed
+    tr = SLOTracker(policy)
+    tr.observe(_req(6, generated=[1, 2]))
+    assert tr.n_untimed == 1 and tr.n_ok == 0 and tr.n_miss == 0
+    # single-token request: tpot unobservable, judged on ttft alone
+    v = policy.verdict(_req(7, submit=0.0, first=0.5, finish=0.6,
+                            generated=[1]))
+    assert v.ok and v.tpot_ok is None
+
+
+def test_metrics_snapshot_and_prometheus_series():
+    m = ServeMetrics()
+    m.slo = SLOTracker(SLOPolicy(ttft_s=1.0, tpot_s=0.5))
+    m.on_finish(_req(1, submit=0.0, admit=0.1, first=0.5, finish=1.0,
+                     generated=[1, 2]))
+    m.on_abort(_req(2, submit=0.0, first=3.0, finish=3.5,
+                    generated=[1], reason="aborted"))
+    m.on_anomaly("host_sync")
+    m.on_anomaly("host_sync")
+    m.on_anomaly("deliver")
+    s = m.snapshot()
+    assert s["slo_ok"] == 1 and s["slo_miss"] == 1
+    assert s["slo_attainment"] == pytest.approx(0.5)
+    assert s["goodput_tokens"] == 2
+    assert s["anomaly_ticks"] == {"host_sync": 2, "deliver": 1}
+    text = m.prometheus(const_labels={"replica": "3"})
+    assert 'llm_serve_goodput_tok_s{replica="3"}' in text
+    assert 'llm_serve_slo_attainment{replica="3"} 0.5' in text
+    assert ('llm_serve_slo_requests_total{verdict="ok",replica="3"} 1'
+            in text)
+    assert 'llm_serve_slo_burn_rate{window="5m",replica="3"}' in text
+    assert ('llm_serve_anomaly_ticks_total{phase="host_sync",'
+            'replica="3"} 2' in text)
+    # no policy → no SLO series (0-with-no-policy would read as a
+    # perfect SLO on a fleet dashboard)
+    off = ServeMetrics().prometheus()
+    assert "goodput" not in off and "slo_" not in off
+
+
+# ---------------------------------------------------------------------------
+# Tick sentinel
+# ---------------------------------------------------------------------------
+
+def test_sentinel_names_guilty_phase():
+    sent = TickSentinel(alpha=0.1, threshold=6.0, warmup_ticks=16,
+                        min_us=10.0)
+    phases = lambda host_sync: (  # noqa: E731
+        ("admission", 0.0, 50.0), ("grow", 50.0, 60.0),
+        ("host_sync", 60.0, 60.0 + host_sync),
+        ("deliver", 60.0 + host_sync, 70.0 + host_sync),
+    )
+    for _ in range(50):
+        assert sent.observe(phases(100.0)) == []
+    out = sent.observe(phases(5000.0))
+    assert [o["phase"] for o in out] == ["host_sync"]
+    assert out[0]["dur_us"] == pytest.approx(5000.0)
+    assert sent.anomalies == {"host_sync": 1}
+    # one spike barely moves the baseline: the next normal tick is clean
+    assert sent.observe(phases(100.0)) == []
+    # a PERSISTENT regression re-baselines instead of firing forever
+    fired = sum(bool(sent.observe(phases(5000.0))) for _ in range(200))
+    assert 0 < fired < 200
+    assert sent.observe(phases(5000.0)) == []
+    assert sent.baselines()["host_sync"]["mean_us"] > 1000.0
+
+
+def test_engine_sentinel_and_hooks_add_zero_recompiles(tiny, tmp_path):
+    """Every observability hook on at once — tracer, sentinel, SLO,
+    request log — runs a full wave of traffic with ZERO extra compiled
+    programs vs the warm engine (the static-shape contract is untouched
+    because everything here is host-side)."""
+    cfg, params = tiny
+    rl = RequestLog(str(tmp_path / "req.jsonl"))
+    engine = _engine(
+        cfg, params,
+        tracer=TraceRecorder(),
+        sentinel=TickSentinel(warmup_ticks=4),
+        request_log=rl,
+    )
+    engine.metrics.slo = SLOTracker(SLOPolicy(ttft_s=5.0, tpot_s=5.0),
+                                    clock=engine.clock)
+    engine.warmup([8], max_new_tokens=4)
+    warm = dict(engine.compile_counts())
+    for i in range(6):
+        engine.submit([3 + i] * 6, 6, seed=i)
+    engine.run_until_complete()
+    assert engine.compile_counts() == warm
+    snap = engine.metrics.snapshot()
+    assert snap["slo_ok"] + snap["slo_miss"] == 6
+    assert rl.flush(10.0)
+    lines = read_request_log(str(tmp_path / "req.jsonl"))
+    assert len(lines) == 6
+    rl.close()
+
+
+# ---------------------------------------------------------------------------
+# Canonical request log
+# ---------------------------------------------------------------------------
+
+def test_request_log_lines_match_metrics(tiny, tmp_path):
+    cfg, params = tiny
+    path = str(tmp_path / "requests.jsonl")
+    rl = RequestLog(path)
+    engine = _engine(cfg, params, request_log=rl,
+                     tracer=TraceRecorder())
+    engine.metrics.slo = SLOTracker(SLOPolicy(ttft_s=30.0, tpot_s=30.0),
+                                    clock=engine.clock)
+    reqs = [engine.submit([5 + i] * 6, 8, seed=i) for i in range(4)]
+    for _ in range(3):
+        engine.step()
+    engine.abort(reqs[1].req_id)
+    engine.run_until_complete()
+    rl.flush(10.0)
+    lines = read_request_log(path)
+    snap = engine.metrics.snapshot()
+    assert len(lines) == snap["finished"] + snap["aborted"] == 4
+    reasons = {}
+    for ln in lines:
+        reasons[ln["reason"]] = reasons.get(ln["reason"], 0) + 1
+    assert reasons == snap["finish_reasons"]
+    assert (sum(ln["new_tokens"] for ln in lines)
+            == snap["total_generated_tokens"])
+    by_rid = {ln["rid"]: ln for ln in lines}
+    for req in reqs:
+        ln = by_rid[req.req_id]
+        # every line has a trace id, an SLO verdict, and a coherent
+        # phase breakdown (parts never exceed the total)
+        assert ln["trace"] and len(ln["trace"]) == 32
+        assert "slo" in ln and "ok" in ln["slo"]
+        ph = ln["phases"]
+        assert ph["total_s"] >= 0.0
+        for key in ("queue_wait_s", "prefill_s", "ttft_s", "decode_s"):
+            if key in ph:
+                assert ph[key] <= ph["total_s"] + 1e-6
+        assert ln["prompt_tokens"] == 6
+        assert ln["replica"] == 0 and ln["replays"] == 0
+    aborted_line = by_rid[reqs[1].req_id]
+    assert aborted_line["reason"] == "aborted"
+    assert aborted_line["slo"]["ok"] is False
+    # the engine minted ONE trace id per request and stamped it on the
+    # spans too — log ↔ trace join on it
+    span_traces = {
+        (ev.get("args") or {}).get("trace")
+        for ev in engine.tracer.events() if ev.get("cat") == "request"
+    }
+    for ln in lines:
+        assert ln["trace"] in span_traces
+    rl.close()
+
+
+def test_request_record_tolerates_bare_request():
+    rec = request_record(_req(7, generated=[1, 2]), reason="length",
+                         policy=SLOPolicy(ttft_s=1.0))
+    assert rec["rid"] == 7 and rec["new_tokens"] == 2
+    assert rec["phases"] == {} and rec["slo"]["timed"] is False
+
+
+def test_request_log_survives_torn_tail(tmp_path):
+    path = str(tmp_path / "log.jsonl")
+    rl = RequestLog(path)
+    rl.emit({"rid": 1, "reason": "stop"})
+    rl.emit({"rid": 2, "reason": "length"})
+    assert rl.flush(10.0)
+    rl.close()
+    with open(path, "a") as f:
+        f.write('{"rid": 3, "reason": "tor')  # torn tail line
+    lines = read_request_log(path)
+    assert [ln["rid"] for ln in lines] == [1, 2]
+
+
+# ---------------------------------------------------------------------------
+# Journal: strict admission fsync + trace/lineage continuity
+# ---------------------------------------------------------------------------
+
+def test_journal_sync_admissions_durable_before_return(tmp_path):
+    path = str(tmp_path / "j")
+    j = RequestJournal(path, sync_admissions=True)
+    req = Request(req_id=5, prompt=np.asarray([1, 2], np.int32),
+                  max_new_tokens=4)
+    req.extra["trace"] = "ab" * 16
+    req.extra["replays"] = 2
+    j.admit(req, now=0.0)
+    # NO flush: strict mode already blocked until the record was on
+    # disk — a kill -9 right here must not lose the admission
+    state, _, _ = scan_journal(path)
+    assert 5 in state
+    assert state[5]["trace"] == "ab" * 16
+    assert state[5]["replays"] == 2
+    j.close()
+
+
+def test_journal_trace_lineage_survive_compaction(tmp_path):
+    path = str(tmp_path / "j")
+    j = RequestJournal(path, compact_bytes=1)  # compact every batch
+    req = Request(req_id=9, prompt=np.asarray([4] * 4, np.int32),
+                  max_new_tokens=8)
+    req.extra.update(trace="cd" * 16, drains=1)
+    j.admit(req, now=0.0)
+    req.generated = [7, 8]
+    j.end_tick([req])
+    assert j.flush(10.0)
+    j.close()
+    j2 = RequestJournal(path)
+    recs = j2.replay()
+    assert len(recs) == 1
+    assert recs[0]["trace"] == "cd" * 16
+    assert recs[0]["drains"] == 1
+    assert recs[0]["tokens"] == [7, 8]
+    j2.close()
+
+
+# ---------------------------------------------------------------------------
+# slo_gate
+# ---------------------------------------------------------------------------
+
+def test_slo_gate_pass_fail_and_missing(tmp_path):
+    from tools.slo_gate import main as gate
+
+    bench = tmp_path / "bench.json"
+    bench.write_text(json.dumps({"detail": {"serve_http_poisson": {
+        "config": "serve_http_poisson",
+        "slo_attainment": 0.97, "goodput_tok_s": 120.0,
+        "slo_burn_rate_5m": 0.4,
+    }}}))
+    ok = ["--config", "serve_http_poisson"]
+    assert gate([str(bench), *ok, "--min-attainment", "0.95"]) == 0
+    assert gate([str(bench), *ok, "--min-attainment", "0.99"]) == 1
+    assert gate([str(bench), *ok, "--min-goodput", "500"]) == 1
+    assert gate([str(bench), *ok, "--max-burn", "0.1"]) == 1
+    # baseline regression: attainment dropped too far
+    base = tmp_path / "base.json"
+    base.write_text(json.dumps({"config": "serve_http_poisson",
+                                "slo_attainment": 0.999,
+                                "goodput_tok_s": 121.0}))
+    assert gate([str(bench), *ok, "--baseline", str(base),
+                 "--max-attainment-drop", "0.01"]) == 1
+    assert gate([str(bench), *ok, "--baseline", str(base),
+                 "--max-attainment-drop", "0.05"]) == 0
+    # missing config / missing SLO numbers → usage error, not pass
+    assert gate([str(bench), "--config", "nope"]) == 2
+    empty = tmp_path / "empty.json"
+    empty.write_text(json.dumps({"config": "x", "tok_s": 1.0}))
+    assert gate([str(empty), "--config", "x"]) == 2
+    # NaN attainment (bench's nothing-was-judged spelling) must NOT
+    # pass a --min-attainment gate — NaN compares False vs everything
+    nan_bench = tmp_path / "nan.json"
+    nan_bench.write_text(json.dumps({
+        "config": "x", "slo_attainment": float("nan"),
+        "goodput_tok_s": 50.0,
+    }))
+    assert gate([str(nan_bench), "--config", "x",
+                 "--min-attainment", "0.9"]) == 1
+    all_nan = tmp_path / "all_nan.json"
+    all_nan.write_text(json.dumps({
+        "config": "x", "slo_attainment": float("nan"),
+    }))
+    assert gate([str(all_nan), "--config", "x"]) == 2
+
+
+# ---------------------------------------------------------------------------
+# The acceptance scenario: fleet kill mid-decode → drained streams,
+# one connected merged trace, request-log lines recording the drain
+# ---------------------------------------------------------------------------
+
+@pytest.mark.http
+def test_fleet_drain_merged_trace_and_request_log(tiny, tmp_path):
+    """One replica dies terminally mid-decode; its streams drain to the
+    peer.  The per-replica trace files MERGE into one connected
+    timeline per request (linked by the shared W3C trace id, with the
+    drain-to-peer and recovery-replay link instants), the canonical
+    request log's lines record the drain (drains=1, peer replica), and
+    the scrape carries replica-labeled goodput series."""
+    cfg, params = tiny
+    journals = [RequestJournal(str(tmp_path / f"j.{i}"))
+                for i in range(2)]
+    tracers = [TraceRecorder() for _ in range(2)]
+    rl = RequestLog(str(tmp_path / "requests.jsonl"))
+    engines = [
+        _engine(cfg, params, journal=journals[i], tracer=tracers[i],
+                request_log=rl, max_slots=4, num_blocks=64)
+        for i in range(2)
+    ]
+    for e in engines:
+        e.metrics.slo = SLOTracker(SLOPolicy(ttft_s=60.0, tpot_s=60.0),
+                                   clock=e.clock)
+    runner = ReplicaRunner(engines, max_restarts=0)
+    prompt, n = [6] * 10, 12  # identical prompts → one sticky replica
+    want = _offline(cfg, params, prompt, n)
+
+    from llm_np_cp_tpu.serve.http.client import astream_completion, http_get
+    from llm_np_cp_tpu.serve.http.server import HttpServer
+
+    async def main():
+        srv = HttpServer(engines[0], model_id="tiny", drain_timeout=20.0,
+                         runner=runner)
+        await srv.start("127.0.0.1", 0)
+        tasks = [
+            asyncio.create_task(astream_completion(
+                srv.host, srv.port,
+                {"prompt": prompt, "max_tokens": n, "stream": True},
+                timeout=90))
+            for _ in range(3)
+        ]
+        while runner.inflight < 3:
+            await asyncio.sleep(0.01)
+        deadline = time.time() + 20
+        owner = None
+        while time.time() < deadline:
+            live_counts = [len(r._live) for r in runner.replicas]
+            if sum(live_counts) == 3 and max(live_counts) == 3:
+                owner = live_counts.index(3)
+                snap = runner.replicas[owner].engine.metrics.snapshot()
+                if snap["total_generated_tokens"] >= 2:
+                    break
+            await asyncio.sleep(0.01)
+        assert owner is not None, "streams did not converge"
+        dead = runner.replicas[owner]
+        dead._on_engine_death("forced: fleet observability e2e",
+                              dead._gen)
+        results = await asyncio.gather(*tasks)
+        loop = asyncio.get_running_loop()
+        _, prom = await loop.run_in_executor(
+            None, http_get, srv.host, srv.port, "/metrics")
+        _, slo_body = await loop.run_in_executor(
+            None, http_get, srv.host, srv.port, "/debug/slo")
+        srv.begin_drain()
+        await srv.serve_until_shutdown()
+        return owner, results, prom.decode(), json.loads(slo_body)
+
+    owner, results, prom, slo = asyncio.run(
+        asyncio.wait_for(main(), timeout=180))
+    peer = 1 - owner
+    for res in results:
+        assert res["status"] == 200
+        assert res["token_ids"] == want, "drained stream diverged"
+
+    # -- request log: the drained terminals carry their survival story
+    rl.flush(10.0)
+    lines = read_request_log(str(tmp_path / "requests.jsonl"))
+    drained = [ln for ln in lines if ln["drains"] >= 1]
+    assert len(drained) == 3, lines
+    for ln in drained:
+        assert ln["replica"] == peer  # the peer finished it
+        assert ln["replays"] >= 1  # the adoption was a recovery replay
+        assert ln["trace"] and "slo" in ln
+    rl.close()
+
+    # -- merged trace: per-replica files stitch into ONE connected
+    # timeline per drained request
+    paths = []
+    for i, tr in enumerate(tracers):
+        p = str(tmp_path / f"trace.{i}.json")
+        tr.dump(p)
+        paths.append(p)
+    merged = merge_traces(paths)
+    timelines = request_timelines(merged["traceEvents"])
+    for ln in drained:
+        tl = timelines[ln["trace"]]
+        pids = {ev.get("pid") for ev in tl}
+        assert pids == {0, 1}, "timeline not connected across replicas"
+        names = [ev["name"] for ev in tl]
+        assert "drain-to-peer" in names
+        assert "recovery-replay" in names
+        assert any(nm.startswith("finish") or nm == "finish"
+                   for nm in names)
+        # the drain link precedes the peer's replay in merged order
+        assert names.index("drain-to-peer") < names.index(
+            "recovery-replay")
+
+    # -- scrape: replica-labeled goodput/attainment series + /debug/slo
+    # (goodput is emitted for BOTH replicas — a policy is attached —
+    # but attainment only where a timed verdict exists: the dead
+    # replica judged nothing, and a fabricated 1.0 would read as a
+    # perfect SLO)
+    assert f'llm_serve_goodput_tok_s{{replica="{peer}"}}' in prom
+    assert f'llm_serve_goodput_tok_s{{replica="{owner}"}}' in prom
+    assert f'llm_serve_slo_attainment{{replica="{peer}"}}' in prom
+    assert slo["slo_ok"] + slo["slo_miss"] + slo["slo_untimed"] >= 3
+    assert len(slo["replicas"]) == 2
+    for jl in journals:
+        jl.flush(5.0)
+        jl.close()
+    state_dead, _, _ = scan_journal(str(tmp_path / f"j.{owner}"))
+    assert state_dead == {}, "dead journal still holds drained streams"
+
+
+# ---------------------------------------------------------------------------
+# summarize_trace --merge CLI
+# ---------------------------------------------------------------------------
+
+def test_summarize_trace_merge_cli(tmp_path, capsys):
+    from tools.summarize_trace import main as st_main
+
+    tid = gen_trace_id()
+    a = TraceRecorder()
+    a.request_phase(1, "queued", args={"trace": tid})
+    b = TraceRecorder()
+    b.request_instant(1, "recovery-replay", args={"trace": tid})
+    b.request_end(1, "stop", args={"trace": tid})
+    pa, pb = str(tmp_path / "a.json"), str(tmp_path / "b.json")
+    a.dump(pa)
+    b.dump(pb)
+    out_path = str(tmp_path / "merged.json")
+    out = st_main([pa, pb, "--merge", out_path])
+    assert "1 traced requests" in out
+    assert "recovery-replay@f1" in out
+    merged = json.load(open(out_path))
+    assert {e.get("pid") for e in merged["traceEvents"]
+            if e.get("cat") == "request"} == {0, 1}
+    # single-file mode still prints the classic summary
+    single = st_main([pa])
+    assert "== tick phases ==" in single

@@ -7,6 +7,7 @@ from tools.lint.rules import (  # noqa: F401  (registration imports)
     host_sync,
     jit_hazard,
     probe_gate,
+    scalar_retrace,
     thread_affinity,
 )
 
@@ -16,4 +17,5 @@ ALL_RULES = (
     thread_affinity.RULE,
     guarded_hook.RULE,
     probe_gate.RULE,
+    scalar_retrace.RULE,
 )

@@ -2,9 +2,11 @@
 
 The serve stack's optional instruments — the ``tracer``
 (serve/tracing.TraceRecorder), the ``faults`` chaos injector
-(serve/faults.FaultInjector), and the ``journal`` durable request
-journal (serve/journal.RequestJournal) — are OFF by default, spelled
-as ``None`` attributes.  The zero-overhead contract is that every hook call sits
+(serve/faults.FaultInjector), the ``journal`` durable request journal
+(serve/journal.RequestJournal), the ``request_log`` canonical request
+log (serve/request_log.RequestLog), the ``sentinel`` tick anomaly
+detector and the ``slo`` goodput tracker (serve/slo.py) — are OFF by
+default, spelled as ``None`` attributes.  The zero-overhead contract is that every hook call sits
 behind an ``is None`` / ``is not None`` check in the same function, so
 instruments-off costs an attribute load and a branch: no dict built for
 a recorder that is not there, no allocation the hot loop did not make
@@ -37,7 +39,7 @@ from tools.lint.core import (
 
 RULE_ID = "R4"
 
-HOOKS = ("tracer", "faults", "journal")
+HOOKS = ("tracer", "faults", "journal", "request_log", "sentinel", "slo")
 # engine methods where binding self.tracer/self.metrics/self.journal to
 # a local is fine: construction, cloning, and the warmup
 # suspend/restore swap — none of them run inside a supervised tick
@@ -160,7 +162,8 @@ class _Rule:
                 chain = attr_chain(node.value)
                 if chain is None or len(chain) != 2 or chain[0] != "self":
                     continue
-                if chain[1] not in ("tracer", "metrics", "journal"):
+                if chain[1] not in ("tracer", "metrics", "journal",
+                                    "request_log"):
                     continue
                 if not any(isinstance(t, ast.Name) for t in node.targets):
                     continue

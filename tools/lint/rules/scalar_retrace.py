@@ -1,0 +1,124 @@
+"""R6 — scalar-retrace: ``jnp.asarray``/``jnp.array`` of fresh Python
+scalars inside engine tick paths.
+
+A Python scalar handed straight to jax adopts a WEAK dtype that can
+drift with the value (and with the x64 flag): ``jnp.asarray(7)`` and
+``jnp.asarray(70000000000)`` commit different dtypes, and a per-tick
+operand whose dtype drifts retraces the jitted step SILENTLY — the
+exact compile-cache bug class the compile-counter lint catches only
+after the fact, caught here at source instead (the ROADMAP "compile-
+cache rule" carried from PR 8).  The fix is one token: wrap the scalar
+in a concrete numpy dtype (``np.int32(n)`` — the engine's existing
+idiom) or pass ``dtype=``.
+
+Scope (the R2 discipline): tick methods — recovered from their own
+``self.tracer.tick(t0, ((name, ta, tb), ...))`` call, no shadow table —
+plus every ``self._helper()`` they transitively call.  Code outside the
+tick loop (step builders, warmup, constructors) may asarray whatever it
+likes: it runs once, not per tick.
+
+Flagged argument shapes (conservative — a plain Name may be an array):
+numeric literals, ``int()``/``float()``/``bool()`` casts, and unary/
+binary arithmetic over those.  An explicit ``dtype=`` (or positional
+dtype) exempts the call: the dtype cannot drift when it is pinned.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.lint.core import Finding, SourceFile, call_name, walk_within
+from tools.lint.rules.host_sync import _tick_phase_tuple
+
+RULE_ID = "R6"
+
+_JNP_CTORS = {("jnp", "asarray"), ("jnp", "array")}
+_CASTS = {"int", "float", "bool"}
+
+
+def _is_fresh_scalar(node: ast.AST) -> bool:
+    """A Python-scalar expression whose jax dtype is value-dependent."""
+    if isinstance(node, ast.Constant):
+        return isinstance(node.value, (int, float)) \
+            and not isinstance(node.value, complex)
+    if (
+        isinstance(node, ast.Call)
+        and isinstance(node.func, ast.Name)
+        and node.func.id in _CASTS
+    ):
+        return True
+    if isinstance(node, ast.UnaryOp):
+        return _is_fresh_scalar(node.operand)
+    if isinstance(node, ast.BinOp):
+        return _is_fresh_scalar(node.left) and _is_fresh_scalar(node.right)
+    return False
+
+
+def _has_pinned_dtype(node: ast.Call) -> bool:
+    if len(node.args) >= 2:
+        return True  # positional dtype
+    return any(kw.arg == "dtype" for kw in node.keywords)
+
+
+class _Rule:
+    id = RULE_ID
+    name = "scalar-retrace"
+    targets = ("llm_np_cp_tpu/serve/engine.py",)
+
+    def check(self, sf: SourceFile) -> list[Finding]:
+        out: list[Finding] = []
+        for cls in ast.walk(sf.tree):
+            if isinstance(cls, ast.ClassDef):
+                self._check_class(sf, cls, out)
+        return out
+
+    def _check_class(self, sf: SourceFile, cls: ast.ClassDef,
+                     out: list[Finding]) -> None:
+        methods = {
+            n.name: n for n in cls.body
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+        }
+        ticks = {
+            name for name, fn in methods.items()
+            if _tick_phase_tuple(fn) is not None
+        }
+        if not ticks:
+            return
+        # transitive closure over self._helper() calls, the R2 walk
+        reach: set[str] = set(ticks)
+        frontier = list(ticks)
+        while frontier:
+            fn = methods[frontier.pop()]
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                chain = call_name(node)
+                if (
+                    chain and len(chain) == 2 and chain[0] == "self"
+                    and chain[1] in methods and chain[1] not in reach
+                ):
+                    reach.add(chain[1])
+                    frontier.append(chain[1])
+        for fname in sorted(reach):
+            for node in walk_within(methods[fname]):
+                if not isinstance(node, ast.Call):
+                    continue
+                chain = call_name(node)
+                if not chain or tuple(chain[-2:]) not in _JNP_CTORS:
+                    continue
+                if not node.args or _has_pinned_dtype(node):
+                    continue
+                if _is_fresh_scalar(node.args[0]):
+                    out.append(Finding(
+                        rule=self.id, path=sf.rel, line=node.lineno,
+                        message=(
+                            f"{'.'.join(chain)}() of a fresh Python "
+                            f"scalar in tick path {fname}() — the weak "
+                            "dtype drifts with the value, a silent "
+                            "retrace per tick; wrap it in a concrete "
+                            "numpy dtype (np.int32(...)) or pass dtype="
+                        ),
+                    ))
+
+
+RULE = _Rule()

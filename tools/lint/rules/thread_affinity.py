@@ -64,6 +64,10 @@ DOMAIN_TABLE: tuple[tuple[str, str, str], ...] = (
     # tick thread (the enqueue-side hooks)
     ("serve/journal.py", "RequestJournal._writer*", "journal"),
     ("serve/journal.py", "*", "engine"),
+    # the request-log WRITER THREAD owns its file handle (same shape as
+    # the journal: engine-side hooks only enqueue under the lock)
+    ("serve/request_log.py", "RequestLog._writer*", "reqlog"),
+    ("serve/request_log.py", "*", "engine"),
     # the ROADMAP router-ownership domain: PrefixRouter's own methods
     # are the only code allowed to mutate routing state — the fleet is
     # loop-owned in HTTP mode (ReplicaRunner) and engine-owned in
@@ -115,6 +119,14 @@ JOURNAL_STATE: tuple[tuple[str, ...], ...] = (
     ("_wsince",),
 )
 
+# request-log-writer-thread-owned state (serve/request_log.py): the
+# ``_w`` naming convention again — only the writer thread touches the
+# open file handle and the lines-written counter
+REQLOG_STATE: tuple[tuple[str, ...], ...] = (
+    ("_wlog",),
+    ("_wlines",),
+)
+
 # (owning domain, state table, remediation hint)
 DOMAIN_OWNED: tuple[tuple[str, tuple, str], ...] = (
     ("engine", OWNED_STATE,
@@ -122,6 +134,8 @@ DOMAIN_OWNED: tuple[tuple[str, tuple, str], ...] = (
     ("router", ROUTER_STATE,
      "go through the PrefixRouter API (route/forget_replica) instead"),
     ("journal", JOURNAL_STATE,
+     "enqueue a record for the writer thread instead"),
+    ("reqlog", REQLOG_STATE,
      "enqueue a record for the writer thread instead"),
 )
 
@@ -141,6 +155,7 @@ LOCK_STATE: tuple[dict, ...] = (
             "kv_bytes_tick", "prefix_blocks_requested",
             "prefix_blocks_hit", "mixed_prefill_tokens",
             "mixed_decode_tokens", "t_start", "t_last",
+            "anomaly_ticks",
         },
         # "caller holds the lock" helpers — annotated, not inferred
         "lock_assumed": {"_record_latencies", "_trim"},
@@ -172,6 +187,15 @@ LOCK_STATE: tuple[dict, ...] = (
         "attrs": {"_pending", "_stopping", "n_records", "bytes_written",
                   "n_fsyncs", "fsync_s", "n_write_errors",
                   "n_fsync_errors", "n_compactions"},
+        "lock_assumed": set(),
+    },
+    {
+        # the request log's engine↔writer boundary, same contract
+        "file": "serve/request_log.py",
+        "class": "RequestLog",
+        "lock": "_lock",
+        "attrs": {"_pending", "_stopping", "n_records",
+                  "n_write_errors"},
         "lock_assumed": set(),
     },
 )

@@ -35,6 +35,8 @@ def main() -> None:
     p.add_argument("--port", type=int, default=0)
     p.add_argument("--port-file", default=None)
     p.add_argument("--journal", default=None)
+    p.add_argument("--journal-sync", choices=["async", "admission"],
+                   default="async")
     p.add_argument("--chaos", default=None)
     p.add_argument("--chaos-seed", type=int, default=0)
     p.add_argument("--slots", type=int, default=4)
@@ -81,9 +83,11 @@ def main() -> None:
     if args.journal:
         from llm_np_cp_tpu.serve.journal import RequestJournal
 
-        journal = RequestJournal(args.journal, fault_injector=injector)
+        journal = RequestJournal(
+            args.journal, fault_injector=injector,
+            sync_admissions=args.journal_sync == "admission")
         print(f"[serve-proc] journal ACTIVE: {args.journal} "
-              f"(epoch {journal.epoch}, "
+              f"(epoch {journal.epoch}, sync={args.journal_sync}, "
               f"{journal.stats()['replayed']} to replay)", flush=True)
 
     chunk = args.block_size * 2

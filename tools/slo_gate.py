@@ -1,0 +1,200 @@
+"""Gate a bench capture on SLO regressions: exit non-zero when goodput
+or attainment fell past the thresholds.
+
+Bench runs record ``slo_attainment`` / ``goodput_tok_s`` (and burn
+rates) alongside tok/s; this tool is the CI tripwire that makes a
+live-TPU bench run GATEABLE on them — a perf "win" that trades away
+SLO-attaining tokens fails the build instead of shipping.
+
+Input shapes accepted (stdlib-only, no repo imports):
+
+- a bench summary JSON (``python bench.py`` output: the config results
+  live under ``detail``), selected with ``--config NAME``;
+- a single config-result object (has a ``"config"`` key);
+- any flat JSON object carrying the SLO keys.
+
+Checks (each only when its flag/keys are present):
+
+- ``--min-attainment F``        — slo_attainment >= F
+- ``--min-goodput F``           — goodput_tok_s >= F
+- ``--max-burn F``              — every slo_burn_rate_* <= F
+- ``--baseline OLD.json``       — compare against an older capture:
+  ``--max-attainment-drop D`` (absolute) and ``--max-goodput-drop R``
+  (fractional, 0.1 = 10%).
+
+Exit codes: 0 pass, 1 regression, 2 usage/missing-data.
+
+Usage::
+
+    python tools/slo_gate.py BENCH.json --config serve_http_poisson \
+        --min-attainment 0.95 --min-goodput 100 \
+        --baseline BENCH_prev.json --max-goodput-drop 0.1
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import sys
+from typing import Any
+
+
+def extract_config(data: Any, config: str | None) -> dict | None:
+    """Find the config-result dict carrying the SLO keys."""
+    if not isinstance(data, dict):
+        return None
+    # a bench summary: results under detail[<config>]
+    detail = data.get("detail")
+    if isinstance(detail, dict) and config is not None \
+            and isinstance(detail.get(config), dict):
+        return detail[config]
+    if config is not None and isinstance(data.get(config), dict):
+        return data[config]
+    if config is None or data.get("config") == config:
+        return data
+    return None
+
+
+def slo_numbers(rec: dict) -> dict[str, float]:
+    """Pull the gateable numbers out of a config result (searching one
+    level of nesting — legs keep their own SLO blocks)."""
+    out: dict[str, float] = {}
+
+    def _num(v: Any) -> float | None:
+        # NaN rides JSON round-trips (bench records attainment as NaN
+        # when nothing was judged) and compares False against every
+        # threshold — treating it as "recorded" would make the gate
+        # pass exactly when SLO accounting broke.  NaN = not a number.
+        if isinstance(v, (int, float)) and not isinstance(v, bool) \
+                and not math.isnan(v):
+            return float(v)
+        return None
+
+    def take(d: dict, prefix: str = "") -> None:
+        for key in ("slo_attainment", "goodput_tok_s"):
+            val = _num(d.get(key))
+            if val is not None:
+                out.setdefault(prefix + key, val)
+        for key, val in d.items():
+            if key.startswith("slo_burn_rate_"):
+                num = _num(val)
+                if num is not None:
+                    out.setdefault(prefix + key, num)
+
+    take(rec)
+    for name, sub in rec.items():
+        if isinstance(sub, dict):
+            if name == "legs":
+                for leg, leg_rec in sub.items():
+                    if isinstance(leg_rec, dict):
+                        take(leg_rec, f"{leg}.")
+            else:
+                take(sub, f"{name}.")
+    return out
+
+
+def _fail(msgs: list[str], text: str) -> None:
+    msgs.append(text)
+
+
+def run_gate(args: argparse.Namespace) -> int:
+    try:
+        data = json.load(open(args.bench))
+    except (OSError, ValueError) as e:
+        print(f"slo-gate: cannot read {args.bench}: {e}", file=sys.stderr)
+        return 2
+    rec = extract_config(data, args.config)
+    if rec is None:
+        print(f"slo-gate: config {args.config!r} not found in "
+              f"{args.bench}", file=sys.stderr)
+        return 2
+    nums = slo_numbers(rec)
+    if not nums:
+        print(f"slo-gate: {args.bench} carries no SLO numbers "
+              "(slo_attainment / goodput_tok_s) — was the bench run "
+              "with an SLO policy?", file=sys.stderr)
+        return 2
+
+    failures: list[str] = []
+    attain = nums.get("slo_attainment")
+    goodput = nums.get("goodput_tok_s")
+    if args.min_attainment is not None:
+        if attain is None:
+            _fail(failures, "slo_attainment missing")
+        elif attain < args.min_attainment:
+            _fail(failures, f"slo_attainment {attain:.4f} < "
+                            f"min {args.min_attainment}")
+    if args.min_goodput is not None:
+        if goodput is None:
+            _fail(failures, "goodput_tok_s missing")
+        elif goodput < args.min_goodput:
+            _fail(failures, f"goodput_tok_s {goodput:.1f} < "
+                            f"min {args.min_goodput}")
+    if args.max_burn is not None:
+        for key, val in sorted(nums.items()):
+            if "slo_burn_rate_" in key and val > args.max_burn:
+                _fail(failures, f"{key} {val:.3f} > max {args.max_burn}")
+
+    if args.baseline:
+        try:
+            base_data = json.load(open(args.baseline))
+        except (OSError, ValueError) as e:
+            print(f"slo-gate: cannot read baseline {args.baseline}: {e}",
+                  file=sys.stderr)
+            return 2
+        base_rec = extract_config(base_data, args.config)
+        base = slo_numbers(base_rec) if base_rec is not None else {}
+        b_attain = base.get("slo_attainment")
+        b_goodput = base.get("goodput_tok_s")
+        if (
+            args.max_attainment_drop is not None
+            and attain is not None and b_attain is not None
+            and b_attain - attain > args.max_attainment_drop
+        ):
+            _fail(failures,
+                  f"slo_attainment dropped {b_attain:.4f} → "
+                  f"{attain:.4f} (> {args.max_attainment_drop} allowed)")
+        if (
+            args.max_goodput_drop is not None
+            and goodput is not None and b_goodput not in (None, 0.0)
+            and (b_goodput - goodput) / b_goodput > args.max_goodput_drop
+        ):
+            _fail(failures,
+                  f"goodput_tok_s dropped {b_goodput:.1f} → "
+                  f"{goodput:.1f} "
+                  f"(> {args.max_goodput_drop:.0%} allowed)")
+
+    summary = ", ".join(f"{k}={v:.4g}" for k, v in sorted(nums.items()))
+    if failures:
+        print("slo-gate: FAIL\n  " + "\n  ".join(failures))
+        print(f"  measured: {summary}")
+        return 1
+    print(f"slo-gate: pass ({summary})")
+    return 0
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(
+        description="Fail (exit 1) when a bench capture's SLO "
+        "attainment/goodput regress past thresholds",
+    )
+    p.add_argument("bench", help="bench JSON (summary or config result)")
+    p.add_argument("--config", default=None,
+                   help="config name inside a bench summary's detail")
+    p.add_argument("--min-attainment", type=float, default=None)
+    p.add_argument("--min-goodput", type=float, default=None,
+                   help="minimum goodput_tok_s")
+    p.add_argument("--max-burn", type=float, default=None,
+                   help="maximum error-budget burn rate, any window")
+    p.add_argument("--baseline", default=None,
+                   help="older bench JSON to compare against")
+    p.add_argument("--max-attainment-drop", type=float, default=0.05,
+                   help="allowed absolute attainment drop vs baseline")
+    p.add_argument("--max-goodput-drop", type=float, default=0.1,
+                   help="allowed fractional goodput drop vs baseline")
+    return run_gate(p.parse_args(argv))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

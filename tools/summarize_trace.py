@@ -16,15 +16,29 @@ from ``GET /debug/trace``) and prints:
   the HTTP layer traced it, the accept→response bracket) per request,
   with eviction/recovery counts and the finish reason.
 
+- **merge mode** (``--merge`` / multiple files) — stitch PER-REPLICA or
+  per-process trace files into ONE request-ordered timeline.  Each
+  recorder stamps a wall-clock anchor (``otherData.wall_epoch``) next
+  to its perf_counter epoch, so files from different processes (a
+  server killed and restarted, or N replica recorders) rebase onto one
+  axis; each file becomes its own pid namespace (Perfetto shows it as a
+  process track) and every request's events — connected across files by
+  the W3C trace id their span args carry — print as one ordered
+  lifecycle: ``queued@f0 → prefill@f0 → drain-to-peer → recovery-replay
+  @f1 → finish``.  ``--merge OUT.json`` also writes the stitched trace
+  for the Perfetto UI.
+
 Usage::
 
     python tools/summarize_trace.py TRACE.json [--top K]
+    python tools/summarize_trace.py A.json B.json [--merge OUT.json]
 """
 
 from __future__ import annotations
 
 import argparse
 import json
+import os
 from collections import defaultdict
 from typing import Any
 
@@ -38,12 +52,100 @@ LIFECYCLE_COLUMNS = ("queued", "prefill", "decode", "http")
 def load_trace(path: str) -> list[dict]:
     """Accepts the ``{"traceEvents": [...]}`` wrapper or a bare event
     list (both are valid Chrome trace JSON)."""
+    return load_trace_file(path)[0]
+
+
+def load_trace_file(path: str) -> tuple[list[dict], float]:
+    """→ ``(events, wall anchor)``; anchor 0.0 for pre-anchor dumps
+    (mergeable only with themselves)."""
     with open(path) as f:
         data = json.load(f)
     events = data.get("traceEvents") if isinstance(data, dict) else data
     if not isinstance(events, list):
         raise ValueError(f"{path}: not a trace-event JSON file")
-    return events
+    anchor = 0.0
+    if isinstance(data, dict):
+        anchor = float(
+            (data.get("otherData") or {}).get("wall_epoch", 0.0)
+        )
+    return events, anchor
+
+
+def merge_traces(paths: list[str]) -> dict:
+    """Stitch N trace files onto one time axis: every file's events are
+    shifted by its wall anchor (relative to the earliest file) and moved
+    into a per-file pid namespace, so per-replica / pre-and-post-restart
+    recorders land as separate process tracks on one timeline."""
+    files = [(p,) + load_trace_file(p) for p in paths]
+    base = min((anchor for _, _, anchor in files if anchor), default=0.0)
+    merged: list[dict] = []
+    for idx, (path, events, anchor) in enumerate(files):
+        shift_us = (anchor - base) * 1e6 if anchor else 0.0
+        merged.append({
+            "name": "process_name", "ph": "M", "pid": idx, "tid": 0,
+            "args": {"name": os.path.basename(path)},
+        })
+        for ev in events:
+            ev = dict(ev)
+            ev["pid"] = idx
+            if "ts" in ev:
+                ev["ts"] = ev["ts"] + shift_us
+            merged.append(ev)
+    return {
+        "traceEvents": merged,
+        "displayTimeUnit": "ms",
+        "otherData": {
+            "merged_from": [os.path.basename(p) for p in paths],
+            "wall_epoch": base,
+        },
+    }
+
+
+def request_timelines(events: list[dict]) -> dict[str, list[dict]]:
+    """trace id → its request/router events in time order (begin spans
+    and instants only — one entry per lifecycle step).  The connectivity
+    check for a merged trace: a request that crossed replicas/restarts
+    has ONE timeline here, spanning multiple pids."""
+    out: dict[str, list[dict]] = defaultdict(list)
+    for ev in events:
+        if ev.get("cat") not in ("request", "router"):
+            continue
+        if ev.get("ph") not in ("b", "n", "i"):
+            continue
+        tid = (ev.get("args") or {}).get("trace")
+        if tid is None:
+            continue
+        out[tid].append(ev)
+    for evs in out.values():
+        evs.sort(key=lambda e: e.get("ts", 0.0))
+    return dict(out)
+
+
+def format_merged(events: list[dict]) -> str:
+    """The request-ordered merged timeline, one line per request."""
+    timelines = request_timelines(events)
+    lines = [f"== merged timeline: {len(timelines)} traced requests =="]
+    for tid, evs in sorted(
+        timelines.items(), key=lambda kv: kv[1][0].get("ts", 0.0)
+    ):
+        steps = []
+        rid = None
+        for ev in evs:
+            rid = ev.get("id", (ev.get("args") or {}).get("rid", rid))
+            name = ev["name"]
+            args = ev.get("args") or {}
+            if name == "finish":
+                name = f"finish({args.get('reason', '?')})"
+            elif name == "drain-to-peer":
+                name = (f"drain-to-peer({args.get('from_replica', '?')}"
+                        f"→{args.get('to_replica', '?')})")
+            steps.append(f"{name}@f{ev.get('pid', 0)}")
+        n_files = len({ev.get("pid", 0) for ev in evs})
+        lines.append(
+            f"  {tid[:12]} rid={rid} files={n_files}: "
+            + " → ".join(steps)
+        )
+    return "\n".join(lines)
 
 
 def phase_totals(events: list[dict]) -> dict[str, dict[str, float]]:
@@ -199,14 +301,29 @@ def format_summary(events: list[dict], top: int = 5) -> str:
 def main(argv: list[str] | None = None) -> str:
     p = argparse.ArgumentParser(
         description="Per-phase totals, slowest ticks, and per-request "
-        "lifecycle tables from a serve --trace-out dump",
+        "lifecycle tables from a serve --trace-out dump; multiple "
+        "files (or --merge) stitch per-replica/per-process traces into "
+        "one request-ordered timeline",
     )
-    p.add_argument("trace", help="trace-event JSON file "
+    p.add_argument("trace", nargs="+",
+                   help="trace-event JSON file(s) "
                    "(--trace-out / GET /debug/trace)")
     p.add_argument("--top", type=int, default=5,
                    help="how many slowest ticks to list")
+    p.add_argument("--merge", default=None, metavar="OUT",
+                   help="write the merged/rebased trace JSON to OUT "
+                   "(implied merge mode; open at ui.perfetto.dev)")
     args = p.parse_args(argv)
-    out = format_summary(load_trace(args.trace), top=args.top)
+    if args.merge is not None or len(args.trace) > 1:
+        merged = merge_traces(args.trace)
+        out = format_merged(merged["traceEvents"])
+        if args.merge:
+            with open(args.merge, "w") as f:
+                json.dump(merged, f)
+            out += (f"\nwrote {len(merged['traceEvents'])} merged "
+                    f"events to {args.merge}")
+    else:
+        out = format_summary(load_trace(args.trace[0]), top=args.top)
     print(out)
     return out
 
