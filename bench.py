@@ -221,6 +221,25 @@ SERVE_MIXED_CONFIGS = {
                               block_size=8),
 }
 
+# Speculative-serving leg (ServeEngine spec_k + serve/spec.py): the
+# SAME Poisson arrival schedule replayed twice on one engine geometry —
+# plain unified tick vs spec-enabled (every request opts in) — over a
+# REPETITIVE-prompt workload (each prompt is a small random pattern
+# tiled to length: the extractive/quoting shape where prompt-lookup
+# drafting pays).  The observables are the draft-then-verify claims on
+# identical arrivals: acceptance rate, decode tok/s and p99 TTFT vs the
+# plain leg, TOKEN PARITY (deterministic verify keys make spec streams
+# byte-identical), and dispatches-per-tick staying ~1 on the spec leg
+# (drafting is host-side; verify lanes ride the one mixed dispatch).
+SERVE_SPEC_CONFIGS = {
+    "serve_spec_poisson": dict(model="llama1b", requests=32, rate=16.0,
+                               prompt_len=512, max_tokens=64, slots=8,
+                               block_size=128, spec_k=4, pattern_len=24),
+    "smoke_serve_spec": dict(model="tiny", requests=8, rate=50.0,
+                             prompt_len=20, max_tokens=12, slots=2,
+                             block_size=8, spec_k=4, pattern_len=5),
+}
+
 # Mesh-sharded serving (ServeEngine mesh_plan + serve/replica.py): ONE
 # shared-prompt Poisson trace (the serve_prefix_shared workload shape)
 # replayed over three topologies on identical arrivals — single chip,
@@ -306,6 +325,7 @@ PRIORITY = [
     "serve_poisson_bs8",  # continuous-batching serving engine (serve/)
     "serve_prefix_shared",  # prefix-cache reuse + gather-vs-paged decode
     "serve_mixed_poisson",  # unified ragged tick vs phase-split head-to-head
+    "serve_spec_poisson",  # draft-then-verify vs plain on identical arrivals
     "serve_http_poisson",  # HTTP front-end overhead vs direct engine calls
     "serve_chaos_poisson",  # supervised recovery under a seeded fault schedule
     "serve_restart_poisson",  # kill -9 + journal replay + client resume
@@ -340,8 +360,8 @@ assert set(PRIORITY) == {
     for n in list(DECODE_CONFIGS) + list(SPEC_CONFIGS)
     + list(PREFILL_CONFIGS) + list(RAGGED_CONFIGS) + list(SERVE_CONFIGS)
     + list(SERVE_HTTP_CONFIGS) + list(SERVE_CHAOS_CONFIGS)
-    + list(SERVE_MIXED_CONFIGS) + list(SERVE_SHARDED_CONFIGS)
-    + list(SERVE_RESTART_CONFIGS)
+    + list(SERVE_MIXED_CONFIGS) + list(SERVE_SPEC_CONFIGS)
+    + list(SERVE_SHARDED_CONFIGS) + list(SERVE_RESTART_CONFIGS)
     if not n.startswith("smoke")
 } | EXTRA_CHILDREN, "PRIORITY out of sync with config dicts"
 
@@ -366,6 +386,10 @@ TIMEOUTS = {
     # its own warmup — the unified leg warms one mixed_step compile per
     # packed-width bucket
     "serve_mixed_poisson": 850,
+    # two unified-tick replays (plain + spec) on one param build; the
+    # spec leg's verify lanes widen the sample operands, so its bucket
+    # warmup compiles its own mixed_step set
+    "serve_spec_poisson": 850,
     # clean + chaos HTTP legs at realtime pacing, plus a supervised
     # restart (backoff + pool rebuild + teacher-forced replay prefills)
     # inside the chaos leg's measured span
@@ -1024,6 +1048,153 @@ def run_serve_mixed_config(name: str) -> dict:
         "dispatches_per_tick": m["dispatches_per_tick"],
         "dispatches_per_tick_split": s["dispatches_per_tick"],
         "dispatch_win": m["dispatches"] < s["dispatches"],
+        "legs": per_leg,
+        "ragged_kernel_probe": ragged_err or "ok",
+    }
+
+
+def run_serve_spec_config(name: str) -> dict:
+    """Speculative serving vs plain unified tick: ONE Poisson arrival
+    schedule over repetitive prompts (random patterns tiled to length —
+    the extractive shape where prompt-lookup drafting pays) replayed
+    through two engines of identical geometry — ``spec_k=0`` and
+    ``spec_k=K`` with every request opted in.  Observables: acceptance
+    rate and mean accept length, decode tok/s and p99 TTFT deltas on
+    identical arrivals, TOKEN PARITY between the legs (the deterministic
+    (seed, content-pos) verify keys make accepted streams byte-identical
+    to plain decode), and dispatches-per-tick staying ~1 on the spec leg
+    (drafting is host-side; verify lanes ride the one mixed dispatch).
+    Both legs carry SLO trackers so ``tools/slo_gate.py`` can gate on
+    the leg summaries (attainment/goodput/burn in the JSON)."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from llm_np_cp_tpu.ops.sampling import Sampler
+    from llm_np_cp_tpu.serve import ServeEngine, poisson_trace
+    from llm_np_cp_tpu.serve.slo import SLOPolicy, SLOTracker
+
+    t0 = time.perf_counter()
+    spec = SERVE_SPEC_CONFIGS[name]
+    config, params = _build_model(spec["model"], tag=name, t0=t0)
+    _phase(name, "params_built", t0)
+    from llm_np_cp_tpu.ops.pallas.support import (
+        kernel_error,
+        ragged_kernel_name,
+    )
+    from llm_np_cp_tpu.serve.engine import pool_geometry
+
+    bs = spec["block_size"]
+    chunk = min(bs * 2, 256)
+    _, num_blocks, max_seq_len = pool_geometry(
+        spec["prompt_len"], spec["max_tokens"], spec["slots"], bs,
+        prefill_chunk=chunk,
+    )
+    ragged_err = kernel_error(ragged_kernel_name(False))
+
+    rng = np.random.default_rng(23)
+    trace = poisson_trace(
+        rng, spec["requests"], rate_rps=spec["rate"],
+        prompt_len_range=(max(spec["prompt_len"] // 4, 2),
+                          spec["prompt_len"]),
+        max_new_tokens=spec["max_tokens"], vocab_size=config.vocab_size,
+        seed_base=23,
+    )
+    # repetitive prompts: tile a small per-request random pattern to the
+    # drawn length, so the suffix n-gram always has a prior occurrence
+    # (the prompt-lookup draft's win case: quoting/extractive traffic)
+    pat = spec["pattern_len"]
+    for item in trace:
+        base = rng.integers(1, config.vocab_size, size=pat,
+                            dtype=np.int64).astype(np.int32)
+        item["prompt"] = np.resize(base, item["prompt"].size)
+    _phase(name, "trace_built", t0)
+
+    per_leg: dict = {}
+    tokens_by_leg: dict = {}
+    for leg, k in (("plain", 0), ("spec", spec["spec_k"])):
+        engine = ServeEngine(
+            params, config,
+            sampler=Sampler(kind="greedy"),
+            max_slots=spec["slots"],
+            num_blocks=num_blocks,
+            block_size=bs,
+            max_seq_len=max_seq_len,
+            prefill_chunk=chunk,
+            cache_dtype=jnp.bfloat16,
+            mixed_step="on",
+            spec_k=k,
+        )
+        engine.warmup([int(t["prompt"].size) for t in trace],
+                      max_new_tokens=spec["max_tokens"])
+        engine.metrics.slo = SLOTracker(
+            SLOPolicy(ttft_s=5.0, tpot_s=1.0, target=0.99),
+            clock=engine.clock,
+        )
+        engine.n_dispatches = 0  # count the measured span only
+        _phase(name, f"warmed_{leg}", t0)
+        leg_trace = [
+            dict(item, speculative=k > 0) for item in trace
+        ]
+        snap = engine.replay_trace(leg_trace)
+        _phase(name, f"trace_drained_{leg}", t0, ticks=snap["ticks"])
+        tokens_by_leg[leg] = {
+            r.req_id: list(r.generated)
+            for r in engine.scheduler.finished
+        }
+        per_leg[leg] = {
+            "ok": snap["finished"] == spec["requests"],
+            "throughput_tok_s": round(snap["throughput_tok_s"], 1),
+            "ttft_s_p50": round(snap.get("ttft_s_p50", float("nan")), 4),
+            "ttft_s_p99": round(snap.get("ttft_s_p99", float("nan")), 4),
+            "decode_tok_s_p50": round(snap.get("decode_tok_s_p50",
+                                               float("nan")), 1),
+            "ticks": snap["ticks"],
+            "dispatches": engine.n_dispatches,
+            "dispatches_per_tick": round(
+                engine.n_dispatches / max(snap["ticks"], 1), 3
+            ),
+            "preemptions": snap["preemptions"],
+            "goodput_tok_s": round(snap.get("goodput_tok_s", 0.0), 1),
+            "slo_attainment": snap.get("slo_attainment"),
+            "slo_burn_rate_5m": snap.get("slo_burn_rate_5m", 0.0),
+            "compile_counts": engine.compile_counts(),
+        }
+        if k:
+            per_leg[leg].update({
+                "spec_k": k,
+                "spec_drafted_tokens": snap.get("spec_drafted_tokens", 0),
+                "spec_accepted_tokens": snap.get("spec_accepted_tokens", 0),
+                "acceptance_rate": round(
+                    snap.get("spec_accept_rate", 0.0), 4
+                ),
+                "spec_accept_len_mean": round(
+                    snap.get("spec_accept_len_mean", 0.0), 3
+                ),
+                "ragged_attn_impl": engine.ragged_attn_impl,
+            })
+        del engine
+    parity = tokens_by_leg["plain"] == tokens_by_leg["spec"]
+    p, s = per_leg["plain"], per_leg["spec"]
+    return {
+        "config": name,
+        "ok": all(r["ok"] for r in per_leg.values()) and parity
+        and s["spec_drafted_tokens"] > 0,
+        "requests": spec["requests"],
+        "rate_rps": spec["rate"],
+        "slots": spec["slots"],
+        "spec_k": spec["spec_k"],
+        "token_parity_spec_vs_plain": parity,
+        # headline: what a verify sweep buys on identical arrivals
+        "acceptance_rate": s["acceptance_rate"],
+        "spec_accept_len_mean": s["spec_accept_len_mean"],
+        "throughput_tok_s": s["throughput_tok_s"],
+        "throughput_tok_s_plain": p["throughput_tok_s"],
+        "ttft_s_p99": s["ttft_s_p99"],
+        "ttft_s_p99_plain": p["ttft_s_p99"],
+        "decode_tok_s_p50": s["decode_tok_s_p50"],
+        "decode_tok_s_p50_plain": p["decode_tok_s_p50"],
+        "dispatches_per_tick": s["dispatches_per_tick"],
+        "ticks_spec_vs_plain": [s["ticks"], p["ticks"]],
         "legs": per_leg,
         "ragged_kernel_probe": ragged_err or "ok",
     }
@@ -2001,7 +2172,8 @@ def run_warm() -> dict:
         if n not in SPEC_CONFIGS and n not in EXTRA_CHILDREN
         and n not in RAGGED_CONFIGS and n not in SERVE_CONFIGS
         and n not in SERVE_HTTP_CONFIGS and n not in SERVE_CHAOS_CONFIGS
-        and n not in SERVE_MIXED_CONFIGS and n not in SERVE_SHARDED_CONFIGS
+        and n not in SERVE_MIXED_CONFIGS and n not in SERVE_SPEC_CONFIGS
+        and n not in SERVE_SHARDED_CONFIGS
         and n not in SERVE_RESTART_CONFIGS
     ]
     for name in warmable[:warm_limit]:
@@ -2343,6 +2515,8 @@ def child_main(mode: str) -> None:
         out = run_serve_config(mode)
     elif mode in SERVE_MIXED_CONFIGS:
         out = run_serve_mixed_config(mode)
+    elif mode in SERVE_SPEC_CONFIGS:
+        out = run_serve_spec_config(mode)
     elif mode in SERVE_HTTP_CONFIGS:
         out = run_serve_http_config(mode)
     elif mode in SERVE_CHAOS_CONFIGS:

@@ -173,6 +173,23 @@ def _add_serve_engine_flags(p: argparse.ArgumentParser,
                    "the decode batch.  Must be >= --slots; larger = "
                    "faster TTFT, smaller = steadier decode cadence.  "
                    "0 = slots + 2*prefill_chunk")
+    p.add_argument("--speculative-serve", action="store_true",
+                   help="speculative decoding inside the unified tick: "
+                   "per-request host-side prompt-lookup drafts verified "
+                   "as ragged q-slices in the SAME one dispatch per "
+                   "tick, accepted with the deterministic (seed, "
+                   "content-pos) sampling keys — streams stay "
+                   "token-identical to plain decode, each accepted "
+                   "draft is a free token per HBM sweep.  Requests opt "
+                   "in per-submit ('\"speculative\": true' on "
+                   "/v1/completions; serve-bench marks its whole "
+                   "trace).  Requires the unified tick (--mixed-step "
+                   "auto/on); per-request fallback to plain decode "
+                   "when rolling acceptance collapses")
+    p.add_argument("--spec-k", type=int, default=4, metavar="N",
+                   help="max draft tokens proposed per speculating "
+                   "request per tick (the verify slice is <= N+1 wide); "
+                   "only read under --speculative-serve")
     p.add_argument("--mesh", default="", metavar="SPEC",
                    help="shard EACH engine over a tensor-parallel mesh "
                    "slice: model=N (parallel/sharding.py syntax; serve "
@@ -379,6 +396,16 @@ def _validate_pool_flags(args) -> None:
             f"({args.slots}) so decode rows are never starved, got "
             f"{budget}"
         )
+    if getattr(args, "speculative_serve", False):
+        if getattr(args, "mixed_step", "off") == "off":
+            raise SystemExit(
+                "--speculative-serve rides the unified tick's batched "
+                "verifier; it cannot run with --mixed-step off"
+            )
+        if getattr(args, "spec_k", 4) < 1:
+            raise SystemExit(
+                f"--spec-k must be >= 1, got {args.spec_k}"
+            )
     for flag in ("slo_ttft", "slo_tpot"):
         if getattr(args, flag, 0.0) < 0:
             raise SystemExit(
@@ -588,6 +615,10 @@ def _build_serve_engine(args, params, config, *, prog: str,
         journal=journal,
         request_log=request_log,
         sentinel=sentinel,
+        spec_k=(
+            getattr(args, "spec_k", 4)
+            if getattr(args, "speculative_serve", False) else 0
+        ),
     )
     slo_ttft = getattr(args, "slo_ttft", 0.0) or None
     slo_tpot = getattr(args, "slo_tpot", 0.0) or None
@@ -615,6 +646,14 @@ def _build_serve_engine(args, params, config, *, prog: str,
     elif getattr(args, "mixed_step", "off") == "auto":
         print(f"[{prog}] --mixed-step auto: ragged kernel unavailable; "
               "using the phase-split tick")
+    if engine.spec_k:
+        print(f"[{prog}] speculative serving ACTIVE: k={engine.spec_k} "
+              "draft tokens/tick, prompt-lookup drafts verified in the "
+              "mixed dispatch (per-request opt-in: "
+              '"speculative": true)')
+    elif getattr(args, "speculative_serve", False):
+        print(f"[{prog}] --speculative-serve requested but the unified "
+              "tick is unavailable; serving plain decode")
     return engine, num_blocks
 
 
@@ -689,6 +728,11 @@ def _run_serve_bench(argv: list[str], default_model: str) -> str:
         seed_base=args.seed,
         distinct_prompts=args.distinct_prompts or None,
     )
+    if engine.spec_k:
+        # serve-bench's whole trace opts in (the HTTP surface is where
+        # per-request opt-in lives); tokens are identical either way
+        for item in trace:
+            item["speculative"] = True
     # compile outside the measured span (steady-state numbers only)
     lens = [int(t["prompt"].size) for t in trace]
     if replica_set is not None:

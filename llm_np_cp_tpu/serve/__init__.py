@@ -22,6 +22,12 @@ Modules:
 - ``prefix_cache`` — refcounted prompt-prefix block sharing: chained
   content hashes → pool block ids, claimed at admission so matching
   prefill chunks are skipped entirely.
+- ``spec``        — host-side draft streams for speculative serving
+  (``DraftState``: prompt-lookup n-gram drafting over each request's
+  own token history); the unified tick packs the drafts as ragged
+  verify slices into its ONE dispatch and accepts the longest prefix
+  matching the deterministic (seed, content-pos) samples — accepted
+  streams are token-identical to plain decode.
 - ``faults``      — deterministic, seeded fault injection
   (``FaultInjector``): chaos specs schedule decode/prefill faults, hung
   or crashed ticks, transient checkpoint IO errors, and HTTP
@@ -93,11 +99,13 @@ from llm_np_cp_tpu.serve.scheduler import (
     RequestState,
     Scheduler,
 )
+from llm_np_cp_tpu.serve.spec import DraftState
 from llm_np_cp_tpu.serve.trace import poisson_trace
 from llm_np_cp_tpu.serve.tracing import TraceRecorder
 
 __all__ = [
     "BlockPool",
+    "DraftState",
     "FaultInjected",
     "FaultInjector",
     "FreeList",

@@ -77,6 +77,17 @@ chunked prefill with decode under ``tick_token_budget`` tokens per tick
 batch (the PR-5 trace finding).  The packed width is bucketed
 (``mixed_buckets``), so the program compiles once per bucket and NEVER
 per tick, whatever the prefill:decode row mix (compile-counter lint).
+
+Speculative serving (``spec_k=K``, unified tick only): per-request
+HOST-SIDE prompt-lookup draft streams (serve/spec.py) propose up to K
+tokens per tick, packed as ragged verify slices of width ≤ K+1 into the
+same one dispatch; the step samples at every packed position with the
+deterministic (seed, content-pos) keys, so the accept walk emits the
+longest draft prefix matching the samples plus the first correction —
+token-identical to plain decode, up to K+1 tokens per HBM sweep.
+Requests opt in per-submit (``speculative=True``) and fall back
+per-request when rolling acceptance collapses; the verify lanes are a
+static [slots, K+1] extension of the step, so zero-recompiles survives.
 """
 
 from __future__ import annotations
@@ -205,6 +216,10 @@ class ServeEngine:
         journal: Any = None,
         request_log: Any = None,
         sentinel: Any = None,
+        spec_k: int = 0,
+        spec_ngram: int = 3,
+        spec_min_accept: float = 0.1,
+        spec_window: int = 64,
     ) -> None:
         if decode_attn_impl not in ("xla", "flash_decode", "paged"):
             raise ValueError(
@@ -215,6 +230,21 @@ class ServeEngine:
             raise ValueError(
                 f"mixed_step must be 'auto', 'on' or 'off', got "
                 f"{mixed_step!r}"
+            )
+        if spec_k < 0:
+            raise ValueError(f"spec_k must be >= 0, got {spec_k}")
+        if spec_k and spec_ngram < 2:
+            # fail at construction, not at the first draft tick inside
+            # the supervised tick thread (DraftState requires
+            # ngram_min <= ngram_max and its lookup floor is 2)
+            raise ValueError(
+                f"spec_ngram must be >= 2, got {spec_ngram}"
+            )
+        if spec_k and mixed_step == "off":
+            raise ValueError(
+                "speculative serving (spec_k > 0) rides the unified "
+                "tick's batched verifier; it cannot run with "
+                "mixed_step='off'"
             )
         from llm_np_cp_tpu.ops.pallas.support import (
             gate_attn_impl,
@@ -323,6 +353,38 @@ class ServeEngine:
                 self.mixed, self.ragged_attn_impl = True, "xla"
             else:
                 self.mixed = False
+        # -- speculative serving (draft-then-verify in the unified tick):
+        # per-request host-side prompt-lookup draft streams propose up to
+        # spec_k tokens; the mixed step packs each speculating request as
+        # a ragged verify slice of width <= spec_k+1 and samples at EVERY
+        # packed position with the (seed, content-pos) keys, so the
+        # longest draft prefix matching those samples is accepted and the
+        # stream stays token-identical to plain decode.  spec_k fixes the
+        # verify-lane width of the compiled step ([R, spec_k+1] sample
+        # operands), so it is an engine build parameter; requests opt in
+        # per-submit and fall back per-request when rolling acceptance
+        # collapses.
+        if spec_k and not self.mixed:
+            # mixed_step='auto' resolved to the phase-split engine (the
+            # ragged probe failed): speculation has no verifier to ride —
+            # serve plain rather than fail, and say so
+            import logging
+
+            logging.getLogger("llm_np_cp_tpu").warning(
+                "spec_k=%d requested but the unified tick is unavailable "
+                "(ragged kernel probe failed under mixed_step='auto'); "
+                "speculative serving disabled, requests decode plain",
+                spec_k,
+            )
+            spec_k = 0
+        self.spec_k = spec_k
+        self.spec_ngram = spec_ngram
+        self.spec_min_accept = spec_min_accept
+        self.spec_window = spec_window
+        # per-request draft streams (serve/spec.DraftState) by req_id;
+        # entries leave with the request (finish/abort), rebuilt lazily
+        # after recovery from prompt + generated
+        self._draft_states: dict[int, Any] = {}
         if (
             self.mixed and self.mesh is not None
             and self.mesh_plan.model > 1 and not self._kv_sharded
@@ -413,8 +475,18 @@ class ServeEngine:
             )
 
             self._q_tile = RAGGED_Q_TILE
+            # verify-lane width of the compiled step: every row carries
+            # spec_k+1 sample slots ([R, W] last_idx/sample_pos operands
+            # and an [R, W] token return) — plain rows use column 0 and
+            # the rest are discarded host-side, so the shape is static
+            # whatever each tick's draft widths turn out to be
+            self._spec_w = self.spec_k + 1
+            # spec engines get verify headroom in the default budget:
+            # drafts only ever spend budget prefill left over, so
+            # without the extra room a busy admission window would trim
+            # every draft to nothing and speculation would never engage
             budget = tick_token_budget or (
-                max_slots + 2 * self.prefill_chunk
+                max_slots * (1 + self.spec_k) + 2 * self.prefill_chunk
             )
             if budget < max_slots:
                 raise ValueError(
@@ -1000,8 +1072,8 @@ class ServeEngine:
             tile_qlen: jnp.ndarray,   # [T/QB] int32
             tables: jnp.ndarray,      # [R, MB] int32 (scratch-0 padded)
             pads: jnp.ndarray,        # [R] int32
-            last_idx: jnp.ndarray,    # [R] int32 packed idx of sample tok
-            sample_pos: jnp.ndarray,  # [R] int32 content pos of that tok
+            last_idx: jnp.ndarray,    # [R, W] int32 packed sample indices
+            sample_pos: jnp.ndarray,  # [R, W] int32 content pos of each
             seeds: jnp.ndarray,       # [R] uint32
         ):
             x = embed_inputs(params, tokens[None, :], config)  # [1, T, H]
@@ -1080,17 +1152,24 @@ class ServeEngine:
                 v_scale=ys[3] if quantized else None,
             )
             new_pages = constrain_pages(new_pages)
-            # logits ONLY at each row's sampled token (decode rows and
-            # prefill segments; rows with nothing to sample point at
-            # packed index 0 and their draw is discarded host-side)
-            xr = x[0][last_idx]  # [R, H]
-            logits = final_logits(params, xr[:, None, :], config)[:, 0]
+            # logits ONLY at each row's sample slots — [R, W] packed
+            # indices: column 0 is the plain sample (decode rows and
+            # completing prefill segments), columns 1..k' are a
+            # speculating row's verify positions; unused slots point at
+            # packed index 0 and their draw is discarded host-side.
+            # Keys derive from (seed, content position) per slot, so a
+            # verify sample at position p is BIT-IDENTICAL to the plain
+            # decode draw at p — the accept walk's whole parity story.
+            xr = x[0][last_idx]  # [R, W, H]
+            logits = final_logits(params, xr, config)  # [R, W, V]
             keys = jax.vmap(
-                lambda s, t: jax.random.fold_in(jax.random.PRNGKey(s), t)
+                lambda s, ps: jax.vmap(
+                    lambda t: jax.random.fold_in(jax.random.PRNGKey(s), t)
+                )(ps)
             )(seeds, sample_pos)
-            nxt = jax.vmap(lambda k, lg: sampler(k, lg[None])[0])(
-                keys, logits
-            )
+            nxt = jax.vmap(
+                jax.vmap(lambda k, lg: sampler(k, lg[None])[0])
+            )(keys, logits)
             return nxt, new_pages
 
         return mixed_step
@@ -1110,6 +1189,7 @@ class ServeEngine:
         deadline_s: float | None = None,
         arrival_time: float | None = None,
         trace_id: str | None = None,
+        speculative: bool = False,
         _recovered: bool = False,
     ) -> Request:
         prompt = np.asarray(prompt_ids, dtype=np.int32).reshape(-1)
@@ -1156,6 +1236,10 @@ class ServeEngine:
             callback=callback,
             on_event=on_event,
             arrival_time=arrival_time if arrival_time is not None else 0.0,
+            # the opt-in survives even on a non-spec engine (inert
+            # there) so a journal replay onto a spec-enabled rebuild
+            # resumes drafting
+            speculative=bool(speculative),
         )
         req.submit_time = self.clock()
         if deadline_s is not None:
@@ -1220,6 +1304,7 @@ class ServeEngine:
         deadline_at: float | None = None,
         trace_id: str | None = None,
         lineage: dict | None = None,
+        speculative: bool = False,
     ) -> Request:
         """Resubmit a request that was in flight when a previous engine
         instance died, with its already-delivered tokens teacher-forced.
@@ -1262,7 +1347,7 @@ class ServeEngine:
         req = self.submit(
             prompt_ids, max_new_tokens, request_id=request_id, seed=seed,
             callback=callback, on_event=on_event, deadline_s=deadline_s,
-            trace_id=trace_id, _recovered=True,
+            trace_id=trace_id, speculative=speculative, _recovered=True,
         )
         if deadline_at is not None:
             req.deadline = deadline_at
@@ -1374,6 +1459,10 @@ class ServeEngine:
             journal=self.journal,
             request_log=self.request_log,
             sentinel=self.sentinel,
+            spec_k=self.spec_k,
+            spec_ngram=self.spec_ngram,
+            spec_min_accept=self.spec_min_accept,
+            spec_window=self.spec_window,
         )
         eng.metrics = self.metrics
         eng.decode_degraded = self.decode_degraded
@@ -1484,6 +1573,7 @@ class ServeEngine:
             req.finish_time = self.clock()
             self.scheduler.finish(req)
             self._requests.pop(req.req_id, None)
+            self._draft_states.pop(req.req_id, None)
             self._flush_detok(req)
             self.metrics.on_finish(req)
             if self.journal is not None:
@@ -1519,6 +1609,7 @@ class ServeEngine:
         req = self._requests.pop(request_id, None)
         if req is None:
             return False
+        self._draft_states.pop(request_id, None)
         self.scheduler.abort(req)
         req.finish_reason = "aborted"
         req.finish_time = self.clock()
@@ -1799,11 +1890,21 @@ class ServeEngine:
         b = self.scheduler.max_slots
         mb = self.max_blocks_per_seq
         bs = self.block_size
-        segs: list[tuple[Request, np.ndarray, int, bool]] = []
+        w_v = self._spec_w
+        # segment = (request, tokens, first cache slot, n_verify):
+        # n_verify sample slots cover the segment's LAST n_verify tokens
+        # — a plain decode row or completing prefill samples 1 (its last
+        # token), a speculating row samples its whole verify slice
+        # (input + drafts), a mid-prefill chunk samples 0
+        segs: list[tuple[Request, np.ndarray, int, int]] = []
         for r in decode_rows:
+            toks = [r.generated[-1]]
+            if r.draft_len:
+                draft = r.extra["spec_draft"]
+                toks.extend(int(t) for t in draft[: r.draft_len])
             segs.append((
-                r, np.asarray([r.generated[-1]], np.int32),
-                r.cache_len - 1, True,
+                r, np.asarray(toks, np.int32),
+                r.cache_len - 1, len(toks),
             ))
         for r, n in prefill_segs:
             content = r.extra["prefill_content"]
@@ -1812,7 +1913,7 @@ class ServeEngine:
             )
             segs.append((
                 r, toks, r.pad + r.prefill_done,
-                r.prefill_done + n >= r.prefill_target,
+                1 if r.prefill_done + n >= r.prefill_target else 0,
             ))
         aligned = sum(_ceil_to(t.size, qb) for _, t, _, _ in segs)
         t_w = self._pick_bucket(max(aligned, qb))
@@ -1829,11 +1930,11 @@ class ServeEngine:
         tile_qlen = np.zeros(nt, np.int32)
         tables = np.zeros((b, mb), np.int32)
         pads = np.zeros(b, np.int32)
-        last_idx = np.zeros(b, np.int32)
-        sample_pos = np.zeros(b, np.int32)
+        last_idx = np.zeros((b, w_v), np.int32)
+        sample_pos = np.zeros((b, w_v), np.int32)
         seeds = np.zeros(b, np.uint32)
         cur = 0
-        for r, toks, start_slot, samples in segs:
+        for r, toks, start_slot, n_verify in segs:
             n = toks.size
             slot = r.slot
             tables[slot, :len(r.block_ids)] = r.block_ids
@@ -1854,9 +1955,11 @@ class ServeEngine:
                 tile_row[ti0 + k] = slot
                 tile_qpos0[ti0 + k] = start_slot + k * qb
                 tile_qlen[ti0 + k] = min(qb, n - k * qb)
-            if samples:
-                last_idx[slot] = cur + n - 1
-                sample_pos[slot] = int(sl[-1]) - r.pad
+            if n_verify:
+                first = n - n_verify  # verify slots = the last n_verify
+                for j in range(n_verify):
+                    last_idx[slot, j] = cur + first + j
+                    sample_pos[slot, j] = start_slot + first + j - r.pad
             cur += n_tiles * qb
         return tuple(self._put(a) for a in (
             tokens, positions, tok_blk, tok_off, tok_row, tok_slot,
@@ -1883,14 +1986,84 @@ class ServeEngine:
         if not self._maybe_finish(req) and self.tracer is not None:
             self.tracer.request_phase(req.req_id, "decode")
 
+    def _draft_tick(self) -> int:
+        """Propose draft tokens for every speculating decode row —
+        HOST-SIDE prompt lookup (serve/spec.DraftState), no device work,
+        so the whole draft phase costs dictionary probes and the tick
+        stays at ~1 dispatch.  Sets ``Request.draft_len`` (the verify
+        width the planner budgets and growth covers) and stashes the
+        tokens in ``extra['spec_draft']``; returns the proposed count
+        for the trace args.  The cap keeps every verify write inside the
+        request's cache ceiling and every possible accept inside its
+        token budget."""
+        if not self.spec_k:
+            return 0
+        from llm_np_cp_tpu.serve.spec import DraftState
+
+        total = 0
+        for r in self.scheduler.running:
+            r.draft_len = 0
+            if not (r.speculative and r.prefilled and r.generated):
+                continue
+            if r.extra.get("spec_off"):
+                continue
+            rem = r.max_new_tokens - len(r.generated)
+            cap = min(self.spec_k, rem - 1,
+                      self.max_seq_len - r.cache_len)
+            if cap <= 0:
+                continue
+            st = self._draft_states.get(r.req_id)
+            if st is None:
+                # lazily built (recovery/preemption re-admissions land
+                # here too): the stream is prompt + generated, exactly
+                # what an uninterrupted request would have indexed
+                st = DraftState(self.spec_ngram)
+                st.extend(int(t) for t in r.prompt)
+                self._draft_states[r.req_id] = st
+            st.extend(r.generated[st.size - r.prompt_len:])
+            draft = st.propose(cap)
+            if draft:
+                r.extra["spec_draft"] = draft
+                r.draft_len = len(draft)
+                total += len(draft)
+        return total
+
+    def _spec_feedback(self, req: Request, drafted: int,
+                       accepted: int) -> None:
+        """One verify round's accounting + the per-request fallback: a
+        stream whose rolling acceptance collapses below
+        ``spec_min_accept`` stops drafting (plain decode row from then
+        on), so cold streams cost at most one wasted verify window —
+        never a standing tax on the tick budget."""
+        self.metrics.on_spec(drafted=drafted, accepted=accepted)
+        st = req.extra.setdefault("spec_acc", [0, 0])
+        st[0] += drafted
+        st[1] += accepted
+        if st[0] < self.spec_window:
+            return
+        if st[1] < self.spec_min_accept * st[0]:
+            req.extra["spec_off"] = True
+            self._draft_states.pop(req.req_id, None)
+            if self.tracer is not None:
+                self.tracer.request_instant(
+                    req.req_id, "spec-fallback", args=self._targs(
+                        req, drafted=st[0], accepted=st[1],
+                    ))
+        else:
+            st[0] //= 2
+            st[1] //= 2
+
     def _step_mixed(self) -> bool:
-        """One unified tick: deadline sweep + admission, block growth,
-        token-budget planning, then ONE mixed ragged dispatch covering
-        every planned prefill chunk slice and decode row.  Phase slices
-        (``admission`` / ``grow`` / ``plan`` / ``mixed_dispatch`` /
-        ``host_sync`` / ``deliver``, serve/tracing.MIXED_TICK_PHASES)
-        keep the consecutive-timestamps sum-to-tick invariant; the tick
-        args additionally carry the prefill/decode token split so
+        """One unified tick: deadline sweep + admission, draft proposal,
+        block growth, token-budget planning, then ONE mixed ragged
+        dispatch covering every planned prefill chunk slice, plain
+        decode row, and speculative verify slice.  Phase slices
+        (``admission`` / ``draft`` / ``grow`` / ``plan`` /
+        ``mixed_dispatch`` / ``host_sync`` / ``deliver``,
+        serve/tracing.MIXED_TICK_PHASES) keep the
+        consecutive-timestamps sum-to-tick invariant; the tick args
+        additionally carry the prefill/decode token split — and, on
+        spec-enabled engines, the draft/accept token split — so
         tools/summarize_trace.py can report mixed-step utilization.
         ``self.tracer`` is re-read at every hook for the same
         zombie-mute reason as the split tick."""
@@ -1909,6 +2082,9 @@ class ServeEngine:
                     ))
         t1 = self.tracer.now_us() if self.tracer is not None else -1.0
 
+        self._draft_tick()
+        td = self.tracer.now_us() if self.tracer is not None else -1.0
+
         for req in self.scheduler.ensure_decode_blocks():
             if self.tracer is not None:
                 self.tracer.request_instant(req.req_id, "evicted-requeued")
@@ -1924,6 +2100,9 @@ class ServeEngine:
         t4 = t5 = t3
         n_prefill_tok = sum(n for _, n in prefill_segs)
         n_decode_tok = len(decode_rows)
+        # drafts actually packed (post-trim) / accepted by the verifier
+        n_spec_tok = sum(r.draft_len for r in decode_rows)
+        n_spec_acc = 0
         if decode_rows or prefill_segs:
             args = self._pack_mixed(decode_rows, prefill_segs)
             td0 = self.clock()
@@ -1939,20 +2118,57 @@ class ServeEngine:
                 # per-request prefill time: the dispatch+sync wall split
                 # by token share (the mixed analogue of Request.prefill_s)
                 per_tok = (self.clock() - td0) / (
-                    n_prefill_tok + n_decode_tok
+                    n_prefill_tok + n_decode_tok + n_spec_tok
                 )
                 for r, n in prefill_segs:
                     r.prefill_s += per_tok * n
             for r, n in prefill_segs:
                 r.prefill_done += n
                 if r.prefill_done >= r.prefill_target:
-                    self._finish_mixed_prefill(r, int(nxt_host[r.slot]))
+                    self._finish_mixed_prefill(r, int(nxt_host[r.slot, 0]))
             for r in decode_rows:
-                self._emit(r, int(nxt_host[r.slot]))
-                self._maybe_finish(r)
+                if not r.draft_len:
+                    self._emit(r, int(nxt_host[r.slot, 0]))
+                    self._maybe_finish(r)
+                    continue
+                # the accept walk: the verifier sampled every position
+                # of this row's slice with the SAME (seed, content-pos)
+                # keys plain decode uses, so sample j is THE token the
+                # stream emits at that position — walk while the drafts
+                # match, stop at the first correction (which is itself
+                # a verified emission), a stop token, or the budget.
+                # Rejected drafts' K/V writes sit past the new
+                # cache_len and are overwritten before ever attended.
+                draft = r.extra.pop("spec_draft")
+                w = 1 + r.draft_len
+                acc = 0
+                for j in range(w):
+                    tok = int(nxt_host[r.slot, j])
+                    self._emit(r, tok)
+                    if j < w - 1 and int(draft[j]) == tok:
+                        # the draft paid off even when this token ENDS
+                        # the stream (a drafted stop token) — count it
+                        # before the finish check, or accepted/rejected
+                        # systematically misreport on short extractive
+                        # completions
+                        acc += 1
+                        if self._maybe_finish(r):
+                            break  # stop token / budget (abort included)
+                    else:
+                        # the correction or the bonus slot — the round
+                        # is over either way
+                        self._maybe_finish(r)
+                        break
+                n_spec_acc += acc
+                drafted = r.draft_len
+                r.draft_len = 0
+                self._spec_feedback(r, drafted, acc)
 
         if self.journal is not None:
-            # same per-tick watermark batching as the split tick
+            # same per-tick watermark batching as the split tick; a
+            # verify round's rows carry every ACCEPTED token this tick
+            # delivered — rejected drafts never reach req.generated, so
+            # they never reach the journal and replay stays exact
             self.journal.end_tick(self._requests.values())
         active = n_decode_tok + len(prefill_segs)
         self.metrics.on_tick(
@@ -1969,23 +2185,32 @@ class ServeEngine:
         )
         if self.tracer is not None and t0 >= 0.0:
             t6 = self.tracer.now_us()
-            self.tracer.tick(t0, (
-                ("admission", t0, t1), ("grow", t1, t2),
-                ("plan", t2, t3), ("mixed_dispatch", t3, t4),
-                ("host_sync", t4, t5), ("deliver", t5, t6),
-            ), args={
+            targs = {
                 "active_slots": active,
                 "queue_depth": self.scheduler.queue_depth,
                 "admitted": len(admitted),
                 "prefill_tokens": n_prefill_tok,
                 "decode_tokens": n_decode_tok,
-            })
+            }
+            if self.spec_k:
+                # the draft/verify split for summarize_trace and the
+                # sentinel: how many verify lanes rode this tick's
+                # dispatch and how many paid off
+                targs["spec_draft_tokens"] = n_spec_tok
+                targs["spec_accept_tokens"] = n_spec_acc
+            self.tracer.tick(t0, (
+                ("admission", t0, t1), ("draft", t1, td),
+                ("grow", td, t2), ("plan", t2, t3),
+                ("mixed_dispatch", t3, t4),
+                ("host_sync", t4, t5), ("deliver", t5, t6),
+            ), args=targs)
             if self.sentinel is not None:
                 # same literal tuple as the tick() call above (R2's
                 # exempt-span recovery reads the literal there)
                 self._sentinel_observe((
-                    ("admission", t0, t1), ("grow", t1, t2),
-                    ("plan", t2, t3), ("mixed_dispatch", t3, t4),
+                    ("admission", t0, t1), ("draft", t1, td),
+                    ("grow", td, t2), ("plan", t2, t3),
+                    ("mixed_dispatch", t3, t4),
                     ("host_sync", t4, t5), ("deliver", t5, t6),
                 ))
         return self.scheduler.has_work
@@ -2015,8 +2240,12 @@ class ServeEngine:
         except Exception as e:  # noqa: BLE001 — any dispatch fault gates
             if not self._degrade_mixed(f"{type(e).__name__}: {e}"):
                 raise
-            # same donated-pages caveat as the split path's retry
             self.n_dispatches += 1
+            # lint: disable=R7 -- same donated-pages caveat as the split
+            # path's retry: injected faults fire BEFORE dispatch, so the
+            # chaos retry never sees consumed pages; a real post-donation
+            # fault raises on the deleted buffers here and the supervisor
+            # restart (which rebuilds the pool) takes over
             return self._mixed_step(self.params, self.pool.pages, *args)
 
     def _degrade_mixed(self, reason: str) -> bool:
@@ -2104,7 +2333,8 @@ class ServeEngine:
             np.zeros(t_w // qb, np.int32), np.zeros(t_w // qb, np.int32),
             np.zeros(t_w // qb, np.int32),
             np.zeros((b, mb), np.int32), np.zeros(b, np.int32),
-            np.zeros(b, np.int32), np.zeros(b, np.int32),
+            np.zeros((b, self._spec_w), np.int32),
+            np.zeros((b, self._spec_w), np.int32),
             np.zeros(b, np.uint32),
         )
         nxt, self.pool.pages = self._mixed_step(
@@ -2136,11 +2366,12 @@ class ServeEngine:
             if not self._degrade_decode(f"{type(e).__name__}: {e}"):
                 raise
             self.n_dispatches += 1
-            # the paged step donated the pool pages; if the fault struck
-            # after they were consumed this retry raises on the deleted
-            # buffers and the supervisor restart (which rebuilds the
-            # pool) takes over — injected faults fire before dispatch,
-            # so the chaos path always retries cleanly
+            # lint: disable=R7 -- the paged step donated the pool pages;
+            # if the fault struck after they were consumed this retry
+            # raises on the deleted buffers and the supervisor restart
+            # (which rebuilds the pool) takes over — injected faults
+            # fire before dispatch, so the chaos path always retries
+            # cleanly
             return self._decode_step(self.params, self.pool.pages, *args)
 
     def _degrade_decode(self, reason: str) -> bool:

@@ -300,6 +300,7 @@ class EngineRunner:
                     "replays": int(rec.get("replays", 0)) + 1,
                     "drains": int(rec.get("drains", 0)),
                 },
+                speculative=bool(rec.get("spec", False)),
             )
         except Exception as e:  # noqa: BLE001 — per-request fate
             # a request the rebuilt pool cannot re-admit fails alone,
@@ -528,6 +529,7 @@ class EngineRunner:
                     request_id=rid, seed=payload.seed, callback=cb,
                     on_event=on_event, deadline_s=deadline,
                     trace_id=getattr(payload, "trace_id", None),
+                    speculative=getattr(payload, "speculative", False),
                 )
             except QueueFull:
                 self._push(rid, ("rejected", 1))
@@ -557,6 +559,9 @@ class EngineRunner:
                     "trace": req.extra.get("trace"),
                     "replays": 0,
                     "drains": 0,
+                    # speculative opt-in: a restart replay resumes the
+                    # same decoding mode (tokens identical either way)
+                    "spec": bool(getattr(payload, "speculative", False)),
                     "tokens": [],
                     # parallel text deltas, so a Last-Event-ID resume
                     # replays the exact text the stream would have

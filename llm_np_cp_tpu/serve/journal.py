@@ -140,6 +140,10 @@ def _apply(state: dict[int, dict], rec: dict) -> int | None:
             "trace": rec.get("trace"),
             "replays": int(rec.get("replays", 0)),
             "drains": int(rec.get("drains", 0)),
+            # the request's speculative opt-in: a replay onto a
+            # spec-enabled engine resumes drafting (tokens are identical
+            # either way — this only preserves the throughput mode)
+            "spec": bool(rec.get("spec", False)),
         }
     elif t == "wm":
         for rid, n, toks in rec["rows"]:
@@ -293,6 +297,8 @@ class RequestJournal:
             val = req.extra.get(key)
             if val:
                 rec[key] = int(val)
+        if getattr(req, "speculative", False):
+            rec["spec"] = True
         self._enqueue(rec)
         if self.sync_admissions:
             # block the enqueuing (engine) thread until the writer has
@@ -460,6 +466,8 @@ class RequestJournal:
                     for key in ("replays", "drains"):
                         if ent.get(key):
                             rec[key] = ent[key]
+                    if ent.get("spec"):
+                        rec["spec"] = True
                     f.write(_frame(rec))
                 f.flush()
                 if self.fsync:

@@ -69,6 +69,10 @@ TTFT_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
                 0.5, 1.0, 2.5, 5.0, 10.0)
 DECODE_TOK_S_BUCKETS = (0.5, 1.0, 2.0, 5.0, 10.0, 20.0, 50.0, 100.0,
                         200.0, 500.0, 1000.0)
+# Speculative accept length per verify round (accepted draft tokens,
+# 0..spec_k): integer upper bounds; the tail bucket absorbs any larger
+# spec_k an operator configures
+SPEC_ACCEPT_BUCKETS = (0.0, 1.0, 2.0, 3.0, 4.0, 6.0, 8.0, 12.0, 16.0)
 
 
 def _pcts(values: list[float], name: str) -> dict[str, float]:
@@ -139,6 +143,14 @@ class ServeMetrics:
         # budget was actually spent — exact counters, never trimmed
         self.mixed_prefill_tokens = 0
         self.mixed_decode_tokens = 0
+        # speculative draft-then-verify accounting (exact counters +
+        # a real accept-length histogram over SPEC_ACCEPT_BUCKETS —
+        # one observation per verify round, value = accepted drafts)
+        self.spec_drafted = 0
+        self.spec_accepted = 0
+        self.spec_rounds = 0
+        self.spec_hist = [0] * (len(SPEC_ACCEPT_BUCKETS) + 1)
+        self.spec_hist_sum = 0.0
 
     # -- record hooks (engine calls these) -----------------------------
     def on_submit(self, req: Request) -> None:
@@ -194,6 +206,19 @@ class ServeMetrics:
         """The tick sentinel named ``phase`` as an outlier this tick."""
         with self._lock:
             self.anomaly_ticks[phase] += 1
+
+    def on_spec(self, *, drafted: int, accepted: int) -> None:
+        """One speculative verify round for one request: ``drafted``
+        candidate tokens rode the tick's dispatch, ``accepted`` of them
+        matched the verifier's deterministic samples."""
+        with self._lock:
+            self.spec_drafted += drafted
+            self.spec_accepted += accepted
+            self.spec_rounds += 1
+            self.spec_hist[
+                bisect.bisect_left(SPEC_ACCEPT_BUCKETS, float(accepted))
+            ] += 1
+            self.spec_hist_sum += accepted
 
     def on_prefix(self, *, requested: int, hits: int) -> None:
         """One prefill's prefix-cache outcome: ``requested`` shareable
@@ -288,6 +313,23 @@ class ServeMetrics:
             prefix_hit = self.prefix_blocks_hit
             out["mixed_prefill_tokens"] = self.mixed_prefill_tokens
             out["mixed_decode_tokens"] = self.mixed_decode_tokens
+            if self.spec_rounds:
+                # reported only once a verify round ran (like the SLO
+                # block): a fabricated 0-acceptance series on a
+                # non-spec engine would read as "speculation broken"
+                out["spec_drafted_tokens"] = self.spec_drafted
+                out["spec_accepted_tokens"] = self.spec_accepted
+                out["spec_rejected_tokens"] = (
+                    self.spec_drafted - self.spec_accepted
+                )
+                out["spec_rounds"] = self.spec_rounds
+                out["spec_accept_rate"] = (
+                    self.spec_accepted / self.spec_drafted
+                    if self.spec_drafted else 0.0
+                )
+                out["spec_accept_len_mean"] = (
+                    self.spec_accepted / self.spec_rounds
+                )
             if self.slo is not None:
                 out.update(self.slo.snapshot())
             if self.anomaly_ticks:
@@ -400,6 +442,19 @@ class ServeMetrics:
              "Unified-tick token budget spent, split by work kind",
              [('{kind="prefill"}', s["mixed_prefill_tokens"]),
               ('{kind="decode"}', s["mixed_decode_tokens"])])
+        # -- speculative decoding (only once a verify round ran — a
+        # constant-zero series on a plain engine would read as a broken
+        # speculation deployment on a fleet dashboard)
+        if "spec_drafted_tokens" in s:
+            emit("spec_tokens_total", "counter",
+                 "Speculative draft tokens by verify outcome",
+                 [('{kind="drafted"}', s["spec_drafted_tokens"]),
+                  ('{kind="accepted"}', s["spec_accepted_tokens"]),
+                  ('{kind="rejected"}', s["spec_rejected_tokens"])])
+            emit("spec_accept_rate", "gauge",
+                 "Accepted / drafted speculative tokens over the "
+                 "traffic span",
+                 [("", s["spec_accept_rate"])])
         emit("throughput_tok_s", "gauge",
              "Generated tokens per second over the traffic span",
              [("", s["throughput_tok_s"])])
@@ -447,6 +502,9 @@ class ServeMetrics:
             ttft_hist_sum = self.ttft_hist_sum
             decode_hist = list(self.decode_hist)
             decode_hist_sum = self.decode_hist_sum
+            spec_hist = list(self.spec_hist)
+            spec_hist_sum = self.spec_hist_sum
+            spec_rounds = self.spec_rounds
 
         def emit_hist(name: str, help_: str, buckets: tuple,
                       counts: list[int], total: float) -> None:
@@ -471,6 +529,11 @@ class ServeMetrics:
                   "Per-request steady decode rate (tokens after the "
                   "first / time after first token)",
                   DECODE_TOK_S_BUCKETS, decode_hist, decode_hist_sum)
+        if spec_rounds:
+            emit_hist("spec_accept_length",
+                      "Accepted draft tokens per speculative verify "
+                      "round",
+                      SPEC_ACCEPT_BUCKETS, spec_hist, spec_hist_sum)
 
         # -- trace-wide quantile gauges alongside the histograms (the
         # single-process view; percentile windows, see max_samples) and
@@ -517,6 +580,13 @@ class ServeMetrics:
         ) + (
             f", {s['rejected']} rejected" if s["rejected"] else ""
         )
+        spec = (
+            f"\nspeculative: {s['spec_accept_rate']:.2f} accept rate "
+            f"({s['spec_accepted_tokens']}/{s['spec_drafted_tokens']} "
+            f"drafts over {s['spec_rounds']} rounds, "
+            f"mean accept len {s['spec_accept_len_mean']:.2f})"
+            if "spec_drafted_tokens" in s else ""
+        )
         return (
             f"requests: {s['submitted']} submitted, {s['finished']} finished"
             f"{aborts}, "
@@ -537,4 +607,5 @@ class ServeMetrics:
             f"p99 {g('occupancy_p99', '{:.2f}')}; "
             f"active_slots mean {g('active_slots_mean', '{:.2f}')}\n"
             f"kv MiB/tick mean {mb_tick}; prefix cache hit rate {prefix}"
+            f"{spec}"
         )

@@ -232,6 +232,7 @@ class ReplicaSet:
                deadline_s: float | None = None,
                arrival_time: float | None = None,
                trace_id: str | None = None,
+               speculative: bool = False,
                replica: int | None = None) -> Request:
         """Route (or pin, via ``replica=``) and submit.  The returned
         Request carries its replica in ``extra['replica']`` and the
@@ -252,6 +253,7 @@ class ReplicaSet:
             prompt_ids, max_new_tokens, request_id=rid, seed=seed,
             callback=callback, on_event=on_event, deadline_s=deadline_s,
             arrival_time=arrival_time, trace_id=trace_id,
+            speculative=speculative,
         )
         if spilled:
             req.extra["spilled"] = True

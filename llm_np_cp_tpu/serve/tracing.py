@@ -65,13 +65,17 @@ TICK_PHASES = (
     "deliver",
 )
 # Unified-tick phase names (ServeEngine._step_mixed): the separate
-# prefill phase collapses into the single mixed dispatch, and the
-# token-budget planner gets its own slice.  Same consecutive-timestamps
-# sum-to-tick contract; tick args additionally carry the
-# prefill_tokens/decode_tokens budget split for
+# prefill phase collapses into the single mixed dispatch, the
+# token-budget planner gets its own slice, and ``draft`` is the
+# host-side speculative proposal pass (prompt-lookup over each
+# speculating request's history — dictionary probes, no device work;
+# ~0 on non-spec engines).  Same consecutive-timestamps sum-to-tick
+# contract; tick args additionally carry the prefill_tokens/
+# decode_tokens budget split — plus spec_draft_tokens/
+# spec_accept_tokens on spec-enabled engines — for
 # tools/summarize_trace.py's utilization line.
 MIXED_TICK_PHASES = (
-    "admission", "grow", "plan", "mixed_dispatch", "host_sync",
+    "admission", "draft", "grow", "plan", "mixed_dispatch", "host_sync",
     "deliver",
 )
 

@@ -1,0 +1,55 @@
+"""R7 bite fixture: donated buffers reused after a faulted dispatch
+(the ``_dispatch_decode`` retry caveat).  Parsed, never imported."""
+
+
+class Engine:
+    def __init__(self):
+        self._decode_step = self._make_decode_step()
+        self._mixed_step = self._make_mixed_step()
+        self._plain_step = self._make_plain_step()
+
+    def _make_decode_step(self):
+        @partial(jax.jit, donate_argnums=(1,))
+        def decode_step(params, pages, tables):
+            return pages
+
+        return decode_step
+
+    def _make_mixed_step(self):
+        # maker chaining: returns another maker's donating step
+        return self._make_decode_step()
+
+    def _make_plain_step(self):
+        @jax.jit
+        def plain_step(params, pages):  # nothing donated
+            return pages
+
+        return plain_step
+
+    def _dispatch_decode(self, *args):
+        try:
+            return self._decode_step(self.params, self.pool.pages, *args)
+        except Exception:
+            self._degrade()
+            return self._decode_step(self.params, self.pool.pages, *args)  # BITE
+
+    def _dispatch_mixed(self, args):
+        try:
+            return self._mixed_step(self.params, self.pool.pages, *args)
+        except Exception:
+            return self._mixed_step(self.params, self.pool.pages, *args)  # BITE
+
+    def _dispatch_rebuilt(self, *args):
+        # FINE: the donated operand is rebuilt before the retry
+        try:
+            return self._decode_step(self.params, self.pool.pages, *args)
+        except Exception:
+            fresh = self.pool.rebuild_pages()
+            return self._decode_step(self.params, fresh, *args)
+
+    def _dispatch_plain(self, *args):
+        # FINE: nothing donated, retrying with the same operand is legal
+        try:
+            return self._plain_step(self.params, self.pool.pages)
+        except Exception:
+            return self._plain_step(self.params, self.pool.pages)

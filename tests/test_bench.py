@@ -85,7 +85,7 @@ def test_priority_matches_config_dicts():
         + list(bench.PREFILL_CONFIGS) + list(bench.RAGGED_CONFIGS)
         + list(bench.SERVE_CONFIGS) + list(bench.SERVE_HTTP_CONFIGS)
         + list(bench.SERVE_CHAOS_CONFIGS) + list(bench.SERVE_MIXED_CONFIGS)
-        + list(bench.SERVE_SHARDED_CONFIGS)
+        + list(bench.SERVE_SPEC_CONFIGS) + list(bench.SERVE_SHARDED_CONFIGS)
         + list(bench.SERVE_RESTART_CONFIGS)
         if not n.startswith("smoke")
     }
@@ -104,6 +104,7 @@ def test_warm_smoke_offline():
                                  and n not in bench.SERVE_HTTP_CONFIGS
                                  and n not in bench.SERVE_CHAOS_CONFIGS
                                  and n not in bench.SERVE_MIXED_CONFIGS
+                                 and n not in bench.SERVE_SPEC_CONFIGS
                                  and n not in bench.SERVE_SHARDED_CONFIGS
                                  and n not in bench.SERVE_RESTART_CONFIGS}
 
@@ -164,6 +165,31 @@ def test_serve_mixed_smoke_offline():
             <= len(legs["mixed"]["buckets"]))
     assert legs["split"]["compile_counts"]["decode_step"] == 1
     assert res["ragged_kernel_probe"] == "ok"  # interpret mode on CPU
+
+
+def test_serve_spec_smoke_offline():
+    """The speculative-serving child: one repetitive-prompt Poisson
+    trace through plain and spec-enabled unified-tick engines — token
+    parity between the legs (deterministic verify keys), a reported
+    acceptance rate with real drafts, ~1 dispatch per tick on the spec
+    leg (drafting is host-side), and slo_gate-compatible leg fields."""
+    res = bench._spawn("smoke_serve_spec", 600, env={"BENCH_PLATFORM": "cpu"})
+    assert res.get("ok") is True, res
+    assert res["token_parity_spec_vs_plain"] is True
+    legs = res["legs"]
+    assert legs["spec"]["spec_drafted_tokens"] > 0
+    assert 0.0 <= res["acceptance_rate"] <= 1.0
+    # drafting never adds dispatches: verify lanes ride the ONE mixed
+    # dispatch per tick
+    assert res["dispatches_per_tick"] <= 1.0
+    # the repetitive workload is the draft's win case: the spec leg must
+    # actually accept drafts and finish in fewer ticks
+    assert legs["spec"]["spec_accepted_tokens"] > 0
+    assert legs["spec"]["ticks"] < legs["plain"]["ticks"]
+    # slo_gate-compatible summary fields on both legs
+    for leg in legs.values():
+        assert "goodput_tok_s" in leg and "slo_attainment" in leg
+    assert set(legs["spec"]["compile_counts"]) == {"mixed_step"}
 
 
 def test_serve_sharded_smoke_offline():

@@ -466,6 +466,31 @@ def test_cli_serve_bench_mesh_and_replicas(fake_load, capsys):
     assert "-- replica 1 --" in out
 
 
+def test_cli_serve_bench_speculative(fake_load, capsys):
+    """--speculative-serve marks the whole bench trace, the banner names
+    the mode, and the metrics block reports a REAL acceptance line (the
+    repetitive-prompt fallback here is the bench's own workload shape —
+    random prompts still draft whenever the suffix n-gram recurs)."""
+    out = cli.run([
+        "serve-bench", "--requests=6", "--rate=50", "--prompt-len=12",
+        "--max-tokens=6", "--slots=2", "--block-size=8", "--seed=1",
+        "--distinct-prompts=2", "--speculative-serve", "--spec-k=3",
+    ])
+    printed = capsys.readouterr().out
+    assert "speculative serving ACTIVE: k=3" in printed
+    assert "speculative:" in out and "accept rate" in out
+
+
+def test_cli_serve_bench_speculative_validation(fake_load):
+    """Speculative flag errors fire BEFORE the model load."""
+    base = ["serve-bench", "--requests=2", "--prompt-len=8",
+            "--max-tokens=2", "--slots=2", "--block-size=8"]
+    with pytest.raises(SystemExit, match="unified tick"):
+        cli.run(base + ["--speculative-serve", "--mixed-step=off"])
+    with pytest.raises(SystemExit, match="--spec-k"):
+        cli.run(base + ["--speculative-serve", "--spec-k=0"])
+
+
 def test_cli_serve_mesh_validation(fake_load):
     """Mesh/replica flag errors fire BEFORE the model load: non-TP
     axes, bad replica counts, and device overcommit are all

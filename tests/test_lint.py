@@ -30,6 +30,7 @@ BITE_FIXTURES = {
     "R4": "r4_guarded_hook.py",
     "R5": "r5_probe_gate.py",
     "R6": "r6_scalar_retrace.py",
+    "R7": "r7_donation.py",
 }
 
 
@@ -45,7 +46,7 @@ def bite_lines(path: pathlib.Path) -> set[int]:
 # ---------------------------------------------------------------------------
 
 def test_all_rules_registered():
-    assert sorted(RULES) == ["R1", "R2", "R3", "R4", "R5", "R6"]
+    assert sorted(RULES) == ["R1", "R2", "R3", "R4", "R5", "R6", "R7"]
     for rule in RULES.values():
         assert rule.targets, f"{rule.id} has no target scope"
 

@@ -153,6 +153,14 @@ def test_parse_completion_request_validation():
         model_id="m", tokenizer=None, max_tokens_cap=32,
     )
     assert ok2.max_tokens == 32
+    # speculative opt-in: default off, bool-validated
+    assert ok.speculative is False
+    ok3 = parse_completion_request(
+        json.dumps({"prompt": [1], "speculative": True}).encode(),
+        model_id="m", tokenizer=None,
+    )
+    assert ok3.speculative is True
+    assert err({"prompt": [1], "speculative": "yes"}).status == 400
 
 
 # ---------------------------------------------------------------------------

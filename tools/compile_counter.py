@@ -331,6 +331,62 @@ def _self_check() -> None:
     assert held == 0, f"unified tick leaked {held} blocks"
     print(f"compile counts OK (unified tick): {eng.compile_counts()}")
 
+    # speculative serving (spec_k > 0): the verify lanes are a STATIC
+    # [R, spec_k+1] extension of the mixed step, so per-tick verify-width
+    # churn (drafts of 0..k tokens per row, rows flipping between spec
+    # and plain, fallback kicking in) must compile NOTHING after the
+    # warmed bucket ladder — and a spec-enabled clone_fresh restart must
+    # share the compiled step, with teacher-forced recovery of a spec
+    # request compiling nothing either.
+    eng = ServeEngine(
+        params, cfg, sampler=Sampler(kind="greedy"), max_slots=2,
+        num_blocks=32, block_size=8, max_seq_len=64,
+        cache_dtype=jnp.float32, mixed_step="on", spec_k=3,
+    )
+    # repetitive prompts so prompt-lookup actually proposes (verify
+    # widths churn through 0..k); one random prompt keeps plain rows in
+    # the same ticks
+    base = rng.integers(1, 200, size=4)
+    spec_prompts = [np.tile(base, 4), rng.integers(1, 200, size=9),
+                    np.tile(rng.integers(1, 200, size=3), 5)]
+    eng.warmup([int(p.size) for p in spec_prompts], max_new_tokens=10)
+    warm = dict(eng.compile_counts())
+    with CompileCounter().watch() as counter:
+        for rep in range(3):
+            for i, p in enumerate(spec_prompts):
+                eng.submit(p, 8 + i, seed=rep * 10 + i, speculative=True)
+            eng.run_until_complete()
+    assert counter.count == 0, (
+        f"spec verify-width churn compiled: {counter.events}"
+    )
+    assert eng.compile_counts() == warm
+    snap = eng.metrics.snapshot()
+    assert snap.get("spec_drafted_tokens", 0) > 0, (
+        "spec workload never drafted — bad self-check workload"
+    )
+    live = [eng.submit(p, 8, speculative=True) for p in spec_prompts]
+    for _ in range(3):
+        eng.step()  # some rows mid-verify
+    rebuilt = eng.clone_fresh()
+    assert rebuilt._mixed_step is eng._mixed_step, (
+        "spec-enabled clone_fresh did not share the compiled mixed step"
+    )
+    with CompileCounter().watch() as counter:
+        for r in live:
+            rebuilt.recover(
+                r.prompt, r.max_new_tokens, request_id=r.req_id,
+                seed=r.seed, generated=list(r.generated),
+                speculative=True,
+            )
+        rebuilt.run_until_complete()
+    assert counter.count == 0, (
+        f"spec restart + recovery replay compiled: {counter.events}"
+    )
+    assert rebuilt.compile_counts() == warm
+    held = rebuilt.pool.stats()["request_held"]
+    assert held == 0, f"spec recovery leaked {held} blocks"
+    print(f"compile counts OK (speculative): {rebuilt.compile_counts()}")
+
     # the MESH-sharded engine (ServeEngine mesh_plan): the static-shape
     # contract extends to placement — params TP-sharded, pool slabs
     # kv-head-partitioned, per-tick operands committed replicated — so

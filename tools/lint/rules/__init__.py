@@ -3,6 +3,7 @@
 """
 
 from tools.lint.rules import (  # noqa: F401  (registration imports)
+    donation,
     guarded_hook,
     host_sync,
     jit_hazard,
@@ -18,4 +19,5 @@ ALL_RULES = (
     guarded_hook.RULE,
     probe_gate.RULE,
     scalar_retrace.RULE,
+    donation.RULE,
 )

@@ -186,8 +186,12 @@ def mixed_utilization(events: list[dict]) -> dict[str, float] | None:
     """Unified-tick (mixed_step) budget utilization from the per-tick
     ``prefill_tokens``/``decode_tokens`` args: how the engine's token
     budget was actually split between catching up prefills and keeping
-    the decode batch fed.  None when no tick carries the args (a
-    phase-split trace)."""
+    the decode batch fed.  Spec-enabled engines additionally stamp
+    ``spec_draft_tokens``/``spec_accept_tokens`` per tick — the
+    draft/verify/accept-length split lands here too (verify lanes =
+    drafted tokens riding the one dispatch; accept rate = how many paid
+    off; emitted decode tokens = decode_tokens + spec_accept_tokens).
+    None when no tick carries the args (a phase-split trace)."""
     ticks = [e.get("args") or {} for e in events
              if e.get("ph") == "X" and e.get("cat") == "tick"]
     ticks = [a for a in ticks if "prefill_tokens" in a]
@@ -196,13 +200,26 @@ def mixed_utilization(events: list[dict]) -> dict[str, float] | None:
     pre = sum(a["prefill_tokens"] for a in ticks)
     dec = sum(a["decode_tokens"] for a in ticks)
     total = pre + dec
-    return {
+    out = {
         "ticks": len(ticks),
         "prefill_tokens": pre,
         "decode_tokens": dec,
         "tokens_per_tick_mean": total / len(ticks),
         "prefill_frac": pre / total if total else 0.0,
     }
+    spec_ticks = [a for a in ticks if "spec_draft_tokens" in a]
+    if spec_ticks:
+        drafted = sum(a["spec_draft_tokens"] for a in spec_ticks)
+        accepted = sum(a["spec_accept_tokens"] for a in spec_ticks)
+        out["spec_draft_tokens"] = drafted
+        out["spec_accept_tokens"] = accepted
+        out["spec_accept_rate"] = accepted / drafted if drafted else 0.0
+        # decode rows with at least one draft lane = verify rounds are
+        # not in the args; accept length per TICK is the honest
+        # per-sweep view here (the exact per-round histogram lives on
+        # /metrics)
+        out["spec_accept_per_tick"] = accepted / len(spec_ticks)
+    return out
 
 
 def slowest_ticks(events: list[dict], k: int) -> list[dict]:
@@ -266,6 +283,13 @@ def format_summary(events: list[dict], top: int = 5) -> str:
             f"({util['tokens_per_tick_mean']:.1f} tok/tick, "
             f"{util['prefill_frac']:.1%} prefill)"
         )
+        if "spec_draft_tokens" in util:
+            lines.append(
+                f"speculative: {util['spec_draft_tokens']} drafted / "
+                f"{util['spec_accept_tokens']} accepted verify tokens "
+                f"({util['spec_accept_rate']:.1%} accept rate, "
+                f"+{util['spec_accept_per_tick']:.2f} free tok/tick)"
+            )
     lines.append(f"== top {top} slowest ticks ==")
     for ev in slowest_ticks(events, top):
         args = ev.get("args") or {}
