@@ -289,6 +289,28 @@ SERVE_RESTART_CONFIGS = {
                                 block_size=8, kill_tick=14),
 }
 
+# Rolling-upgrade leg (serve/lifecycle.py + ReplicaSet.rolling_upgrade):
+# ONE Poisson trace over a direct-mode DP fleet, two legs on identical
+# arrivals — steady (no roll) and rolling (a full replica-by-replica
+# weight swap triggered mid-trace: each replica drains its in-flight
+# streams to peers, rebuilds on the "new" checkpoint via clone_fresh,
+# and rejoins routing).  Observables are the zero-downtime claims:
+# ZERO dropped streams, token parity across the roll (the drain is
+# teacher-forced), p99 TTFT degradation during the roll bounded
+# (ttft_p99_degradation — what tools/slo_gate.py
+# --max-p99-ttft-degradation consumes in CI), and zero new compiles
+# for a same-shaped swap (params are jit call arguments; pinned).
+SERVE_ROLLING_CONFIGS = {
+    "serve_rolling_upgrade": dict(model="llama1b", requests=32, rate=16.0,
+                                  prompt_len=512, max_tokens=64, slots=8,
+                                  block_size=128, replicas=3,
+                                  roll_after_ticks=8),
+    "smoke_serve_rolling": dict(model="tiny", requests=16, rate=50.0,
+                                prompt_len=16, max_tokens=8, slots=2,
+                                block_size=8, replicas=3,
+                                roll_after_ticks=3),
+}
+
 SPEC_CONFIGS = {
     # batched self-speculation: bf16 target + int8 self-draft, γ=4
     "int8_spec_bs8": dict(model="llama1b", batch=8, prompt_len=128,
@@ -329,6 +351,7 @@ PRIORITY = [
     "serve_http_poisson",  # HTTP front-end overhead vs direct engine calls
     "serve_chaos_poisson",  # supervised recovery under a seeded fault schedule
     "serve_restart_poisson",  # kill -9 + journal replay + client resume
+    "serve_rolling_upgrade",  # zero-downtime weight swap over the DP fleet
     "serve_sharded_poisson",  # TP pool sharding + DP replicas vs single chip
     "gemma2_2b_bs8",      # Gemma north-star number (VERDICT task 3)
     "int8_bs8",           # roofline-gap anchor (VERDICT task 6)
@@ -362,6 +385,7 @@ assert set(PRIORITY) == {
     + list(SERVE_HTTP_CONFIGS) + list(SERVE_CHAOS_CONFIGS)
     + list(SERVE_MIXED_CONFIGS) + list(SERVE_SPEC_CONFIGS)
     + list(SERVE_SHARDED_CONFIGS) + list(SERVE_RESTART_CONFIGS)
+    + list(SERVE_ROLLING_CONFIGS)
     if not n.startswith("smoke")
 } | EXTRA_CHILDREN, "PRIORITY out of sync with config dicts"
 
@@ -402,6 +426,11 @@ TIMEOUTS = {
     # kill / restart), each paying its own model build + warmup, plus
     # the realtime client traffic spans
     "serve_restart_poisson": 1300,
+    # two trace replays over a 3-replica direct-mode fleet on one param
+    # build, each replica warmed, plus the roll's three clone_fresh
+    # rebuilds + teacher-forced drain re-prefills inside the measured
+    # span
+    "serve_rolling_upgrade": 850,
     # prefill-dominated: the marginal measurement's extra prefill+half
     # decode per rep nearly doubles measured-phase wall time
     "llama3b_seq2048_bs8": 700,
@@ -2073,6 +2102,157 @@ def run_serve_restart_config(name: str) -> dict:
     }
 
 
+def run_serve_rolling_config(name: str) -> dict:
+    """Zero-downtime rolling upgrade: ONE Poisson trace over a
+    direct-mode 3-replica fleet, replayed twice on identical arrivals —
+    steady (no roll) vs rolling (a full replica-by-replica weight swap
+    triggered mid-trace).  The swap drains each replica's in-flight
+    streams to peers (teacher-forced — token parity across the roll is
+    the drain contract), rebuilds it on the "new" checkpoint via
+    clone_fresh (same params object here: the zero-compile same-shape
+    case, pinned), and rejoins routing.  ``ttft_p99_degradation`` and
+    ``dropped_streams`` are what ``tools/slo_gate.py
+    --max-p99-ttft-degradation`` gates in CI."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from llm_np_cp_tpu.ops.sampling import Sampler
+    from llm_np_cp_tpu.serve import (
+        LifecycleController,
+        ReplicaSet,
+        ServeEngine,
+        SLOPolicy,
+        SLOTracker,
+        poisson_trace,
+    )
+    from llm_np_cp_tpu.serve.engine import pool_geometry
+    from llm_np_cp_tpu.serve.trace import replay_arrivals
+
+    t0 = time.perf_counter()
+    spec = SERVE_ROLLING_CONFIGS[name]
+    config, params = _build_model(spec["model"], tag=name, t0=t0)
+    _phase(name, "params_built", t0)
+    bs = spec["block_size"]
+    chunk = min(bs * 2, 256)
+    _, num_blocks, max_seq_len = pool_geometry(
+        spec["prompt_len"], spec["max_tokens"], spec["slots"], bs,
+        prefill_chunk=chunk,
+    )
+    rng = np.random.default_rng(29)
+    trace = poisson_trace(
+        rng, spec["requests"], rate_rps=spec["rate"],
+        prompt_len_range=(max(spec["prompt_len"] // 4, 1),
+                          spec["prompt_len"]),
+        max_new_tokens=spec["max_tokens"], vocab_size=config.vocab_size,
+        seed_base=29,
+    )
+    lens = [int(t["prompt"].size) for t in trace]
+    _phase(name, "trace_built", t0)
+
+    def build_fleet() -> ReplicaSet:
+        engines = []
+        for _ in range(spec["replicas"]):
+            e = ServeEngine(
+                params, config,
+                sampler=Sampler(kind="greedy"),
+                max_slots=spec["slots"],
+                num_blocks=num_blocks,
+                block_size=bs,
+                max_seq_len=max_seq_len,
+                prefill_chunk=chunk,
+                cache_dtype=jnp.bfloat16,
+                mixed_step="auto",
+            )
+            e.warmup(lens, max_new_tokens=spec["max_tokens"])
+            e.metrics.slo = SLOTracker(
+                SLOPolicy(ttft_s=2.5, tpot_s=2.5), clock=e.clock,
+            )
+            engines.append(e)
+        return ReplicaSet(engines)
+
+    def leg_stats(snap) -> dict:
+        return {
+            "ok": snap["finished"] == spec["requests"],
+            "finished": snap["finished"],
+            "throughput_tok_s": round(snap["throughput_tok_s"], 1),
+            "ttft_s_p50": round(snap.get("ttft_s_p50", float("nan")), 4),
+            "ttft_s_p99": round(snap.get("ttft_s_p99", float("nan")), 4),
+            "slo_attainment": snap.get("slo_attainment", float("nan")),
+            "goodput_tok_s": round(snap.get("goodput_tok_s", 0.0), 1),
+            "slo_burn_rate_5m": snap.get("slo_burn_rate_5m", 0.0),
+            "router_routed": snap["router_routed"],
+            "router_spilled": snap["router_spilled"],
+        }
+
+    # -- leg 1: steady (no roll) — the baseline every delta reads from
+    steady_fleet = build_fleet()
+    _phase(name, "warmed_steady", t0)
+    snap_s = steady_fleet.replay_trace(trace)
+    steady_tokens = [list(r.generated) for r in steady_fleet.finished]
+    steady = leg_stats(snap_s)
+    del steady_fleet  # free its pools before the measured rolling leg
+    _phase(name, "steady_done", t0)
+
+    # -- leg 2: rolling — same arrivals, a full fleet roll mid-trace
+    fleet = build_fleet()
+    controller = LifecycleController(fleet)
+    _phase(name, "warmed_rolling", t0)
+    rolled: dict = {}
+
+    def on_tick(i: int) -> None:
+        if i == spec["roll_after_ticks"] and not rolled:
+            rolled.update(controller.rolling_upgrade(
+                lambda: params, version=1, steps_between=1,
+            ))
+
+    # process-global counter, not engines[0]'s cache sizes: a compile
+    # on a not-yet-rolled peer (or on a callable the roll then
+    # discards) must count too
+    from tools.compile_counter import CompileCounter
+
+    with CompileCounter().watch() as roll_counter:
+        snap_r = replay_arrivals(fleet, trace, fleet.snapshot,
+                                 on_tick=on_tick)
+    _phase(name, "rolling_done", t0, ticks=snap_r["ticks"])
+    rolling_tokens = [list(r.generated) for r in fleet.finished]
+    rolling = leg_stats(snap_r)
+    compiles_added = roll_counter.count
+    parity = rolling_tokens == steady_tokens
+    dropped = spec["requests"] - snap_r["finished"]
+    deg = (
+        rolling["ttft_s_p99"] / steady["ttft_s_p99"]
+        if steady["ttft_s_p99"] else float("nan")
+    )
+    lifecycle = {}
+    for e in fleet.engines:
+        for k, v in e.metrics.snapshot().get(
+                "lifecycle_actions", {}).items():
+            lifecycle[k] = lifecycle.get(k, 0) + v
+    versions = snap_r["weights_versions"]
+    return {
+        "config": name,
+        "ok": (steady["ok"] and rolling["ok"] and parity
+               and bool(rolled) and dropped == 0
+               and compiles_added == 0
+               and all(v == 1 for v in versions)),
+        "requests": spec["requests"],
+        "rate_rps": spec["rate"],
+        "replicas": spec["replicas"],
+        "roll_after_ticks": spec["roll_after_ticks"],
+        "rolled": rolled.get("rolled"),
+        "drained_streams": rolled.get("drained"),
+        "dropped_streams": dropped,
+        "token_parity_across_roll": parity,
+        # the headline pair slo_gate consumes
+        "ttft_p99_degradation": round(deg, 3),
+        "compiles_added_by_roll": compiles_added,
+        "compile_counts": dict(fleet.engines[0].compile_counts()),
+        "weights_versions": versions,
+        "lifecycle_actions": lifecycle,
+        "legs": {"steady": steady, "rolling": rolling},
+    }
+
+
 def run_spec_config(name: str) -> dict:
     import numpy as np
 
@@ -2175,6 +2355,7 @@ def run_warm() -> dict:
         and n not in SERVE_MIXED_CONFIGS and n not in SERVE_SPEC_CONFIGS
         and n not in SERVE_SHARDED_CONFIGS
         and n not in SERVE_RESTART_CONFIGS
+        and n not in SERVE_ROLLING_CONFIGS
     ]
     for name in warmable[:warm_limit]:
         spec = {**DECODE_CONFIGS, **PREFILL_CONFIGS}[name]
@@ -2523,6 +2704,8 @@ def child_main(mode: str) -> None:
         out = run_serve_chaos_config(mode)
     elif mode in SERVE_RESTART_CONFIGS:
         out = run_serve_restart_config(mode)
+    elif mode in SERVE_ROLLING_CONFIGS:
+        out = run_serve_rolling_config(mode)
     elif mode in SERVE_SHARDED_CONFIGS:
         out = run_serve_sharded_config(mode)
     else:

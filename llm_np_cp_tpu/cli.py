@@ -215,7 +215,9 @@ def _add_serve_engine_flags(p: argparse.ArgumentParser,
                    "events 'site@N[:COUNT][=ARG]' (deterministic) or "
                    "'site%%P[=ARG]' (seeded probability) joined by ';' — "
                    "sites: decode, prefill, tick_crash, tick_hang, "
-                   "ckpt_read, http_429, http_reset.  Default: the "
+                   "ckpt_read, http_429, http_reset, proc_kill, "
+                   "journal_write, journal_fsync, host_sync, "
+                   "upgrade_ckpt.  Default: the "
                    "LLMTPU_CHAOS_SPEC env var, else chaos off (injection "
                    "points are zero-overhead no-ops)")
     p.add_argument("--chaos-seed", type=int, default=0,
@@ -263,6 +265,25 @@ def _add_serve_engine_flags(p: argparse.ArgumentParser,
                    metavar="K",
                    help="sentinel sensitivity: a phase is an outlier "
                    "past baseline + K deviations")
+    p.add_argument("--auto-actions", action=argparse.BooleanOptionalAction,
+                   default=False,
+                   help="closed-loop sentinel/SLO auto-actions "
+                   "(serve/lifecycle.ActionPolicy): a persistent "
+                   "host_sync regression (named by --tick-sentinel) "
+                   "sheds prefill budget in the unified tick's planner; "
+                   "an SLO error-budget burn rate past "
+                   "--shed-burn-threshold flips admission to 503-first "
+                   "load shedding with a burn-scaled Retry-After.  Both "
+                   "actions are reversible (they release when the "
+                   "signal clears), rate-limited, and counted as "
+                   "llm_serve_lifecycle_actions_total{action=}.  "
+                   "Default: off (no policy is constructed)")
+    p.add_argument("--shed-burn-threshold", type=float, default=2.0,
+                   metavar="B",
+                   help="auto-actions: start 503-first load shedding "
+                   "when the 5m SLO burn rate exceeds B (release at "
+                   "B/2; needs --slo-ttft/--slo-tpot for burn to be "
+                   "measured)")
     p.add_argument("--jax-profile", default=None, metavar="DIR",
                    help="capture a jax.profiler device trace into DIR "
                    "for the run; the serve dispatch phases are wrapped "
@@ -416,6 +437,11 @@ def _validate_pool_flags(args) -> None:
     if not (0.0 < target < 1.0):
         raise SystemExit(
             f"--slo-target must be in (0, 1), got {target}"
+        )
+    if getattr(args, "shed_burn_threshold", 2.0) <= 0:
+        raise SystemExit(
+            f"--shed-burn-threshold must be > 0, got "
+            f"{args.shed_burn_threshold}"
         )
 
 
@@ -576,6 +602,26 @@ def _build_serve_engine(args, params, config, *, prog: str,
         if not quiet:
             print(f"[{prog}] tick sentinel ACTIVE "
                   f"(threshold {sentinel.threshold:g} deviations)")
+    actions = None
+    if getattr(args, "auto_actions", False):
+        from llm_np_cp_tpu.serve.lifecycle import ActionPolicy
+
+        # one policy PER ENGINE (verdict state is tick-thread-owned);
+        # each replica's _build_serve_engine call constructs its own
+        actions = ActionPolicy(
+            burn_threshold=getattr(args, "shed_burn_threshold", 2.0),
+        )
+        if not quiet:
+            slo_on = bool(getattr(args, "slo_ttft", 0.0)
+                          or getattr(args, "slo_tpot", 0.0))
+            print(f"[{prog}] auto-actions ACTIVE: shed prefill on "
+                  "persistent host_sync anomalies"
+                  + ("" if sentinel_on else
+                     " (needs --tick-sentinel to observe)")
+                  + ", 503-first shedding past burn "
+                  f"{actions.burn_threshold:g}"
+                  + ("" if slo_on else
+                     " (needs --slo-ttft/--slo-tpot to measure burn)"))
     request_log = shared_request_log
     rl_path = getattr(args, "request_log", None)
     if request_log is None and rl_path:
@@ -615,6 +661,7 @@ def _build_serve_engine(args, params, config, *, prog: str,
         journal=journal,
         request_log=request_log,
         sentinel=sentinel,
+        actions=actions,
         spec_k=(
             getattr(args, "spec_k", 4)
             if getattr(args, "speculative_serve", False) else 0
@@ -899,6 +946,25 @@ def _run_http_serve(argv: list[str], default_model: str) -> str:
         print(f"[serve] listening on http://{server.host}:{server.port} "
               f"(POST /v1/completions, GET /healthz, GET /metrics)")
 
+    def upgrade_loader(body: dict):
+        # POST /admin/upgrade: reload a checkpoint (the body may name a
+        # different --model) and hand the params to the rolling swap.
+        # Geometry must match — the pool/steps are shaped by config,
+        # and a mismatched checkpoint must abort the roll, not corrupt
+        # the fleet
+        ns = argparse.Namespace(**vars(args))
+        if body.get("model"):
+            ns.model = str(body["model"])
+        print(f"[serve] admin upgrade: loading checkpoint {ns.model}")
+        _, new_params, new_config = _load(ns)
+        if new_config != config:
+            raise ValueError(
+                f"upgrade checkpoint {ns.model} has a different model "
+                "geometry than the serving config; rolling upgrades "
+                "swap weights, not architectures"
+            )
+        return new_params
+
     with _jax_profile_ctx(args):
         serve_forever(
             engine,
@@ -917,6 +983,7 @@ def _run_http_serve(argv: list[str], default_model: str) -> str:
             exit_after_s=args.exit_after_s,
             on_started=on_started,
             runner=runner,
+            upgrade_loader=upgrade_loader,
         )
     _dump_trace(tracer, args, "serve")
     if engine.request_log is not None:

@@ -65,6 +65,12 @@ Modules:
   hash, so shared-prompt traffic lands on the replica already holding
   its blocks; spill-to-least-loaded under queue pressure, per-replica
   abort/drain/supervised recovery.
+- ``lifecycle``   — zero-downtime fleet operations: rolling checkpoint
+  upgrades (drain-to-peer, clone_fresh on new weights, compiled steps
+  re-jitted once per fleet, per-request weight-version tagging),
+  elastic add/remove replicas with an optional ``Autoscaler`` policy,
+  and the ``ActionPolicy`` closing the loop from sentinel/SLO signals
+  to shed-prefill and 503-first load-shedding auto-actions.
 - ``http``        — the OpenAI-compatible streaming HTTP front-end
   (``serve`` CLI subcommand): SSE token streams, abort on disconnect or
   deadline, 429 backpressure off the scheduler's queue cap, Prometheus
@@ -79,6 +85,12 @@ from llm_np_cp_tpu.serve.engine import (
     worst_case_slots,
 )
 from llm_np_cp_tpu.serve.journal import RequestJournal, scan_journal
+from llm_np_cp_tpu.serve.lifecycle import (
+    ActionPolicy,
+    Autoscaler,
+    LifecycleController,
+    UpgradeAborted,
+)
 from llm_np_cp_tpu.serve.metrics import ServeMetrics
 from llm_np_cp_tpu.serve.prefix_cache import PrefixCache, prefix_block_keys
 from llm_np_cp_tpu.serve.request_log import RequestLog, read_request_log
@@ -104,8 +116,12 @@ from llm_np_cp_tpu.serve.trace import poisson_trace
 from llm_np_cp_tpu.serve.tracing import TraceRecorder
 
 __all__ = [
+    "ActionPolicy",
+    "Autoscaler",
     "BlockPool",
     "DraftState",
+    "LifecycleController",
+    "UpgradeAborted",
     "FaultInjected",
     "FaultInjector",
     "FreeList",

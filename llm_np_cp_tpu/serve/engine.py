@@ -216,6 +216,8 @@ class ServeEngine:
         journal: Any = None,
         request_log: Any = None,
         sentinel: Any = None,
+        actions: Any = None,
+        weights_version: int = 0,
         spec_k: int = 0,
         spec_ngram: int = 3,
         spec_min_accept: float = 0.1,
@@ -414,6 +416,20 @@ class ServeEngine:
         # the tracer's phase timestamps, so it observes only when a
         # tracer is attached.  Same is-None discipline
         self.sentinel = sentinel
+        # lifecycle auto-actions (serve/lifecycle.ActionPolicy): the
+        # sentinel's host_sync verdicts and the SLO burn rate feed it
+        # once per tick; its shed-prefill verdict caps the planner's
+        # budget and its shed-load verdict flips HTTP admission to
+        # 503-first.  Same is-None zero-overhead discipline
+        self.actions = actions
+        # which checkpoint these params came from: stamped onto every
+        # request at admission (journal/request-log carry it), bumped
+        # by a rolling upgrade's clone_fresh(params=..., ...)
+        if weights_version < 0:
+            raise ValueError(
+                f"weights_version must be >= 0, got {weights_version}"
+            )
+        self.weights_version = int(weights_version)
         # reason string once the paged decode step faulted at dispatch
         # and the engine fell back to the gather impl (None = healthy)
         self.decode_degraded: str | None = None
@@ -1254,6 +1270,12 @@ class ServeEngine:
             trace_id = gen_trace_id()
         if trace_id is not None:
             req.extra["trace"] = trace_id
+        # the weight version serving this request, stamped at admission:
+        # journal admission records and request-log lines carry it, so a
+        # stream that survives a mid-roll drain still reports the ONE
+        # version it was admitted under (recover() overrides the stamp
+        # with the original admission's version)
+        req.extra["weights_version"] = self.weights_version
         try:
             # supervisor replays of already-admitted work are exempt from
             # the queue cap, like preemption requeues — the cap must not
@@ -1305,6 +1327,7 @@ class ServeEngine:
         trace_id: str | None = None,
         lineage: dict | None = None,
         speculative: bool = False,
+        weights_version: int | None = None,
     ) -> Request:
         """Resubmit a request that was in flight when a previous engine
         instance died, with its already-delivered tokens teacher-forced.
@@ -1352,6 +1375,11 @@ class ServeEngine:
         if deadline_at is not None:
             req.deadline = deadline_at
         req.generated = [int(t) for t in generated]
+        if weights_version is not None:
+            # the ORIGINAL admission's weight version, not this engine's:
+            # a drain onto an already-rolled peer must keep reporting
+            # the version the request was admitted (and served) under
+            req.extra["weights_version"] = int(weights_version)
         if lineage:
             # before the journal re-admission below, so a SECOND crash
             # replays the lineage along with the token state
@@ -1380,6 +1408,7 @@ class ServeEngine:
         reason: str,
         trace_id: str | None = None,
         lineage: dict | None = None,
+        weights_version: int | None = None,
     ) -> str | None:
         """Terminal bookkeeping for a request that was recovered ALREADY
         complete (every token generated pre-crash; only its finish event
@@ -1400,6 +1429,10 @@ class ServeEngine:
         req.finish_reason = reason
         if trace_id is not None:
             req.extra["trace"] = trace_id
+        req.extra["weights_version"] = int(
+            weights_version if weights_version is not None
+            else self.weights_version
+        )
         if lineage:
             req.extra.update({
                 k: int(v) for k, v in lineage.items()
@@ -1428,15 +1461,24 @@ class ServeEngine:
             detok.push(tok)
         return detok.flush() or None
 
-    def clone_fresh(self) -> "ServeEngine":
+    def clone_fresh(self, *, params: Params | None = None,
+                    weights_version: int | None = None) -> "ServeEngine":
         """A fresh engine with the same params/config/geometry and a
         zeroed block pool — what a supervisor restart rebuilds after a
         crash.  The compiled step programs are SHARED with this engine
         (identical geometry → identical jaxprs), so a restart never
         re-traces or recompiles (pinned by tools/compile_counter.py), and
-        the metrics object carries over so operator counters survive."""
+        the metrics object carries over so operator counters survive.
+
+        ``params``/``weights_version`` override the weights — the
+        rolling-upgrade rebuild (serve/replica.py): the jitted steps
+        take params as a call ARGUMENT, so a swap to same-shaped
+        weights reuses every warm compile, and a swap that changes the
+        param avals re-traces once per shared callable — once per
+        FLEET, because rolled peers adopt the first rebuilt replica's
+        callables via ``share_compiled_steps``."""
         eng = ServeEngine(
-            self.params, self.config,
+            params if params is not None else self.params, self.config,
             sampler=self.sampler,
             stop_tokens=self.stop_tokens,
             max_slots=self.scheduler.max_slots,
@@ -1459,6 +1501,11 @@ class ServeEngine:
             journal=self.journal,
             request_log=self.request_log,
             sentinel=self.sentinel,
+            actions=self.actions,
+            weights_version=(
+                weights_version if weights_version is not None
+                else self.weights_version
+            ),
             spec_k=self.spec_k,
             spec_ngram=self.spec_ngram,
             spec_min_accept=self.spec_min_accept,
@@ -1485,6 +1532,44 @@ class ServeEngine:
             setattr(eng, name, getattr(self, name))
         return eng
 
+    def share_compiled_steps(self, src: "ServeEngine") -> None:
+        """Adopt ``src``'s jitted step callables (geometry-identical
+        engines only — the fleet's homogeneity check guarantees it).
+        A rolling upgrade calls this on every rolled replica after the
+        first, so new-weight avals are traced/compiled once per FLEET,
+        not once per replica; an elastic ``add_replica`` clone uses it
+        the same way.
+
+        Placement-guarded: the step closures pin output shardings to
+        the BUILDING engine's mesh (``_constrain_pages``), so engines
+        on different device slices (DP placement meshes — one chip per
+        replica) must keep their own callables; adopting a peer's
+        would pin this replica's pages to the peer's devices and fault
+        at dispatch.  Those fleets compile once per device slice —
+        still once per set of identical placements, never per roll."""
+        if not self._same_placement(src):
+            return
+        if self.mixed and src.mixed \
+                and self.ragged_attn_impl == src.ragged_attn_impl:
+            self._mixed_step = src._mixed_step
+            return
+        if not self.mixed and not src.mixed:
+            for name in ("_prefill_step", "_sample_first",
+                         "_scatter_prefill", "_gather_prefix"):
+                setattr(self, name, getattr(src, name))
+            if self.decode_attn_impl == src.decode_attn_impl:
+                self._decode_step = src._decode_step
+
+    def _same_placement(self, src: "ServeEngine") -> bool:
+        """Do both engines place params/pool/operands on the same
+        device set?  (Sharing compiled steps across placements is a
+        correctness error, not an optimization miss.)"""
+        if self.mesh is None and src.mesh is None:
+            return True
+        if self.mesh is None or src.mesh is None:
+            return False
+        return list(self.mesh.devices.flat) == list(src.mesh.devices.flat)
+
     def _targs(self, req: Request, **kw: Any) -> dict:
         """Span args with the request's W3C trace id merged in (when it
         has one) — what lets ``summarize_trace --merge`` stitch the
@@ -1510,16 +1595,17 @@ class ServeEngine:
 
     def _sentinel_observe(
         self, phases: tuple[tuple[str, float, float], ...],
-    ) -> None:
+    ) -> list[dict]:
         """Feed one tick's phase slices to the anomaly sentinel; an
         outlier stamps a trace instant naming the guilty phase and
-        bumps the per-phase anomaly counter."""
+        bumps the per-phase anomaly counter.  Returns the outliers —
+        the tick's ``_actions_tick`` hands them to the ActionPolicy."""
         sent = self.sentinel
         if sent is None:
-            return
+            return []
         outliers = sent.observe(phases)
         if not outliers:
-            return
+            return []
         for o in outliers:
             self.metrics.on_anomaly(str(o["phase"]))
         guilty = outliers[0]
@@ -1530,6 +1616,36 @@ class ServeEngine:
                 "baseline_us": round(float(guilty["baseline_us"]), 1),
                 "tick": sent.ticks,
             })
+        return outliers
+
+    def _tick_budget(self) -> int:
+        """This tick's token budget: the configured budget, capped by
+        the ActionPolicy's shed-prefill verdict (decode rows are never
+        shed — the floor is max_slots)."""
+        if self.actions is None:
+            return self.tick_token_budget
+        return self.actions.plan_budget(
+            self.tick_token_budget, self.scheduler.max_slots
+        )
+
+    def _actions_tick(self, outliers: list[dict]) -> None:
+        """Feed one tick's sentinel verdicts + SLO burn to the
+        ActionPolicy; count and trace every action flip (the
+        ``llm_serve_lifecycle_actions_total{action=}`` series and the
+        ``lifecycle-action`` trace instants the auto-action e2e reads).
+        ``self.actions`` is re-read per hook like tracer/metrics — the
+        supervisor mutes a zombie engine by clearing it."""
+        if self.actions is None:
+            return
+        for action in self.actions.on_tick(
+            outliers, getattr(self.metrics, "slo", None)
+        ):
+            self.metrics.on_lifecycle_action(action)
+            if self.tracer is not None and self.actions is not None:
+                self.tracer.instant(
+                    "lifecycle-action", cat="lifecycle",
+                    args={"action": action, **self.actions.state_args()},
+                )
 
     def _emit(self, req: Request, token: int) -> None:
         req.generated.append(int(token))
@@ -1817,6 +1933,13 @@ class ServeEngine:
                     self._put(seeds),
                 )
             t4 = self.tracer.now_us() if self.tracer is not None else -1.0
+            if self.faults is not None:
+                # injected host_sync regression: a REAL stall inside
+                # the host_sync phase window, attributed by the
+                # sentinel to the right phase (ActionPolicy food)
+                hang = self.faults.trip("host_sync")
+                if hang is not None:
+                    time.sleep(hang)
             nxt_host = np.asarray(nxt)
             t5 = self.tracer.now_us() if self.tracer is not None else -1.0
             for r in running:
@@ -1835,6 +1958,7 @@ class ServeEngine:
             preemptions_total=self.scheduler.n_preemptions,
             kv_bytes=self._kv_bytes_tick(running) if running else 0,
         )
+        outliers: list[dict] = []
         if self.tracer is not None and t0 >= 0.0:
             t6 = self.tracer.now_us()
             self.tracer.tick(t0, (
@@ -1850,11 +1974,12 @@ class ServeEngine:
                 # same literal phase tuple the tracer records (R2
                 # recovers its exempt spans from the tick() literal, so
                 # the tuple cannot be hoisted into a shared local)
-                self._sentinel_observe((
+                outliers = self._sentinel_observe((
                     ("admission", t0, t1), ("prefill", t1, t2),
                     ("grow", t2, t3), ("decode_dispatch", t3, t4),
                     ("host_sync", t4, t5), ("deliver", t5, t6),
                 ))
+        self._actions_tick(outliers)
         return self.scheduler.has_work
 
     # ------------------------------------------------------------------
@@ -2093,7 +2218,7 @@ class ServeEngine:
         t2 = self.tracer.now_us() if self.tracer is not None else -1.0
 
         decode_rows, prefill_segs = self.scheduler.plan_tick(
-            self.tick_token_budget, self.prefill_chunk
+            self._tick_budget(), self.prefill_chunk
         )
         t3 = self.tracer.now_us() if self.tracer is not None else -1.0
 
@@ -2112,6 +2237,12 @@ class ServeEngine:
                     args, bool(prefill_segs)
                 )
             t4 = self.tracer.now_us() if self.tracer is not None else -1.0
+            if self.faults is not None:
+                # injected host_sync regression (the split tick's twin
+                # site): a real stall in the host_sync phase window
+                hang = self.faults.trip("host_sync")
+                if hang is not None:
+                    time.sleep(hang)
             nxt_host = np.asarray(nxt)
             t5 = self.tracer.now_us() if self.tracer is not None else -1.0
             if n_prefill_tok:
@@ -2183,6 +2314,7 @@ class ServeEngine:
             prefill_tokens=n_prefill_tok,
             decode_tokens=n_decode_tok,
         )
+        outliers: list[dict] = []
         if self.tracer is not None and t0 >= 0.0:
             t6 = self.tracer.now_us()
             targs = {
@@ -2207,12 +2339,13 @@ class ServeEngine:
             if self.sentinel is not None:
                 # same literal tuple as the tick() call above (R2's
                 # exempt-span recovery reads the literal there)
-                self._sentinel_observe((
+                outliers = self._sentinel_observe((
                     ("admission", t0, t1), ("draft", t1, td),
                     ("grow", td, t2), ("plan", t2, t3),
                     ("mixed_dispatch", t3, t4),
                     ("host_sync", t4, t5), ("deliver", t5, t6),
                 ))
+        self._actions_tick(outliers)
         return self.scheduler.has_work
 
     def _dispatch_mixed(self, args: tuple, has_prefill: bool) -> tuple:

@@ -42,6 +42,16 @@ Sites (each named where it is threaded in):
 - ``journal_write`` / ``journal_fsync`` — fail the journal writer
                     thread's file write / fsync (durability degradation:
                     the batch is dropped and counted, serving continues)
+- ``host_sync``   — sleep ``ARG`` seconds inside the tick's host_sync
+                    phase (the device→host token fetch): a REAL
+                    injected host-sync regression the tick sentinel
+                    attributes to the right phase — what the
+                    ``ActionPolicy`` shed-prefill auto-action is
+                    tested against (serve/lifecycle.py)
+- ``upgrade_ckpt`` — fail the checkpoint read of a rolling weight
+                    upgrade mid-roll (serve/replica.py
+                    ``rolling_upgrade``): the roll must abort cleanly
+                    with the replica still live on its old weights
 
 No-op by default: nothing constructs an injector unless a chaos spec is
 given (``--chaos-spec`` / ``LLMTPU_CHAOS_SPEC``), and every injection
@@ -67,6 +77,8 @@ SITES = (
     "proc_kill",
     "journal_write",
     "journal_fsync",
+    "host_sync",
+    "upgrade_ckpt",
 )
 
 

@@ -33,19 +33,30 @@ def http_get(host: str, port: int, path: str,
         conn.close()
 
 
-def post_completion(host: str, port: int, payload: dict[str, Any],
-                    timeout: float = 60.0) -> tuple[int, dict[str, Any]]:
-    """Non-streaming completion through the stock stdlib client."""
+def http_post(host: str, port: int, path: str,
+              payload: dict[str, Any] | None = None,
+              timeout: float = 60.0) -> tuple[int, dict[str, Any]]:
+    """JSON POST to an arbitrary path (the /admin lifecycle endpoints);
+    returns (status, parsed body or {})."""
     conn = http.client.HTTPConnection(host, port, timeout=timeout)
     try:
-        body = json.dumps(payload)
-        conn.request("POST", "/v1/completions", body=body,
+        conn.request("POST", path,
+                     body=json.dumps(payload or {}),
                      headers={"Content-Type": "application/json"})
         resp = conn.getresponse()
         raw = resp.read()
-        return resp.status, json.loads(raw) if raw else {}
+        try:
+            return resp.status, json.loads(raw) if raw else {}
+        except ValueError:
+            return resp.status, {"raw": raw.decode(errors="replace")}
     finally:
         conn.close()
+
+
+def post_completion(host: str, port: int, payload: dict[str, Any],
+                    timeout: float = 60.0) -> tuple[int, dict[str, Any]]:
+    """Non-streaming completion through the stock stdlib client."""
+    return http_post(host, port, "/v1/completions", payload, timeout)
 
 
 async def _astream_once(

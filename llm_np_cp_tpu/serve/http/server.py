@@ -92,7 +92,8 @@ class _ResumeEcho:
 _REASONS = {
     200: "OK", 400: "Bad Request", 404: "Not Found",
     405: "Method Not Allowed", 408: "Request Timeout",
-    413: "Payload Too Large", 429: "Too Many Requests",
+    409: "Conflict", 413: "Payload Too Large",
+    429: "Too Many Requests",
     500: "Internal Server Error", 503: "Service Unavailable",
 }
 MAX_BODY_BYTES = 8 << 20
@@ -167,6 +168,11 @@ class EngineRunner:
         # restart budget exhausted): the server turns /healthz unhealthy
         # and rejects new work instead of silently wedging every stream
         self.crashed: str | None = None
+        # exactly one rolling upgrade at a time (the ReplicaRunner
+        # fleet guard, fleet-of-one spelling): a second concurrent
+        # detach would supersede the first rebuild's generation and its
+        # replay snapshot would never run anywhere
+        self._upgrade_lock = threading.Lock()
         # -- supervision state (everything below guarded by _sup_lock) -
         # reentrant: _exec holds it across engine.submit/abort (so the
         # generation check is atomic with the engine call), and abort's
@@ -202,6 +208,15 @@ class EngineRunner:
         # was attached — journal-recovered requests above all), kept so
         # a late resume still gets its suffix + finish; bounded LRU
         self._resumable: dict[int, dict] = {}
+        # CLAIMED terminals (a resume already replayed them once), kept
+        # in a smaller LRU so a client whose first resume read tore on
+        # the wire can retry instead of 404ing — the PR 9 single-shot
+        # claim made bounded multi-read
+        self._claimed: dict[int, dict] = {}
+        # a planned weight swap's (params, version, share_from) for the
+        # next rebuild (rolling upgrade); under _sup_lock, consumed by
+        # _rebuild_and_replay on the new tick thread
+        self._pending_weights: tuple | None = None
         # fleet hook (serve/replica.ReplicaRunner): called from
         # _terminal_crash with the in-flight replay list; returns the
         # rids a live peer adopted (those streams are NOT abort-flushed)
@@ -301,6 +316,7 @@ class EngineRunner:
                     "drains": int(rec.get("drains", 0)),
                 },
                 speculative=bool(rec.get("spec", False)),
+                weights_version=rec.get("wv"),
             )
         except Exception as e:  # noqa: BLE001 — per-request fate
             # a request the rebuilt pool cannot re-admit fails alone,
@@ -338,19 +354,23 @@ class EngineRunner:
                 "replays": int(rec.get("replays", 0)) + 1,
                 "drains": int(rec.get("drains", 0)),
             },
+            weights_version=rec.get("wv"),
         )
         if rid in self._live:
             self._push(rid, ("finish", reason, tail))
             self._live.pop(rid, None)
+            self._claim_insert(rid, self._fin_record(rec, reason, tail))
         else:
             self._stash_resumable(rid, rec, reason, tail)
 
-    def _stash_resumable(self, rid: int, rec: dict, reason: str,
-                         tail: str | None) -> None:
-        """Park a DETACHED stream's terminal output (bounded LRU): a
-        client resuming after the finish still gets its journaled
-        suffix + finish exactly once."""
-        self._resumable[rid] = {
+    @staticmethod
+    def _fin_record(rec: dict, reason: str,
+                    tail: str | None) -> dict:
+        """The ONE parked/claimed terminal record shape (the resume
+        wire format) — built here for ``_stash_resumable`` and both
+        ``_claim_insert`` call sites, so a new field cannot be added to
+        one copy and silently missed in another."""
+        return {
             "tokens": list(rec["tokens"]),
             "deltas": list(rec.get("deltas") or
                            [None] * len(rec["tokens"])),
@@ -360,6 +380,13 @@ class EngineRunner:
             # ORIGINAL trace context
             "trace": rec.get("trace"),
         }
+
+    def _stash_resumable(self, rid: int, rec: dict, reason: str,
+                         tail: str | None) -> None:
+        """Park a DETACHED stream's terminal output (bounded LRU): a
+        client resuming after the finish still gets its journaled
+        suffix + finish exactly once."""
+        self._resumable[rid] = self._fin_record(rec, reason, tail)
         while len(self._resumable) > 512:
             self._resumable.pop(next(iter(self._resumable)))
 
@@ -412,6 +439,12 @@ class EngineRunner:
         if self.crashed:
             return "crashed"
         return "degraded" if self.recovering else "ok"
+
+    def serving_engines(self) -> list:
+        """Engines whose ActionPolicy verdicts may govern admission —
+        a crashed engine's tick thread can never RELEASE a shed flag,
+        so its frozen verdict must not shed the server forever."""
+        return [] if self.crashed else [self.engine]
 
     def next_rid(self) -> int:
         return next(self._rid)
@@ -483,13 +516,27 @@ class EngineRunner:
                         req.req_id, rec, event,
                         req.extra.pop("final_text_delta", None))
                 return
-            self._push(req.req_id, (
-                "finish", event,
-                req.extra.pop("final_text_delta", None),
-            ))
+            tail = req.extra.pop("final_text_delta", None)
+            self._push(req.req_id, ("finish", event, tail))
             self._live.pop(req.req_id, None)
+            if rec is not None:
+                # the DELIVERED terminal stays re-readable for a while
+                # too: a client whose final read tore on the wire can
+                # retry the whole stream from the claimed LRU
+                self._claim_insert(
+                    req.req_id, self._fin_record(rec, event, tail))
 
         return cb, on_event
+
+    def _claim_insert(self, rid: int, fin: dict) -> None:
+        """Park a terminal's full output in the CLAIMED LRU (bounded,
+        most recent last): any recently finished stream can be
+        re-replayed by a retrying client — the PR 9 single-shot claim,
+        made bounded multi-read."""
+        self._claimed.pop(rid, None)
+        self._claimed[rid] = fin
+        while len(self._claimed) > 64:
+            self._claimed.pop(next(iter(self._claimed)))
 
     def _next_handback(self, gen: int) -> tuple | None:
         """Pop the next handed-back command — only for the LIVE
@@ -562,6 +609,10 @@ class EngineRunner:
                     # speculative opt-in: a restart replay resumes the
                     # same decoding mode (tokens identical either way)
                     "spec": bool(getattr(payload, "speculative", False)),
+                    # the weight version that admitted this request — a
+                    # restart replay or a drain-to-peer keeps reporting
+                    # it, whatever weights the adopting engine runs
+                    "wv": int(req.extra.get("weights_version", 0)),
                     "tokens": [],
                     # parallel text deltas, so a Last-Event-ID resume
                     # replays the exact text the stream would have
@@ -589,7 +640,15 @@ class EngineRunner:
         Last-Event-ID is the count it HAS, so the replay starts there."""
         _, rid, last_idx, loop, aq = cmd
         rec = self._inflight.get(rid)
-        fin = self._resumable.get(rid) if rec is None else None
+        fin = None
+        if rec is None:
+            fin = self._resumable.get(rid)
+            if fin is None:
+                # bounded multi-read: a terminal a resume already
+                # claimed stays re-readable from the small claimed LRU,
+                # so a client retrying after a flaky first read is not
+                # 404'd (the PR 9 single-shot claim, loosened)
+                fin = self._claimed.get(rid)
         src = rec if rec is not None else fin
         verdict = None
         if src is not None and rid in self._live:
@@ -635,8 +694,12 @@ class EngineRunner:
             self._push(rid, ("token", int(tok),
                              deltas[i] if i < len(deltas) else None))
         if fin is not None:
-            # the stream finished while detached: suffix + finish, once
+            # the stream finished while detached: suffix + finish.  The
+            # claim moves it to the bounded claimed-LRU (most recent
+            # claim last) instead of discarding — a retry re-reads it
+            # until the LRU evicts
             self._resumable.pop(rid, None)
+            self._claim_insert(rid, fin)
             self._push(rid, ("finish", fin["reason"], fin["tail"]))
             self._live.pop(rid, None)
 
@@ -718,6 +781,13 @@ class EngineRunner:
                 # long-running server's memory flat
                 engine.scheduler.finished.clear()
                 engine.scheduler.aborted.clear()
+            elif engine.actions is not None:
+                # an idle server must still RELEASE auto-actions:
+                # shed_load 503s the fresh work that would otherwise
+                # produce the ticks on_tick releases through, so a
+                # drained-idle server would shed forever once the
+                # in-flight streams finished
+                engine._actions_tick([])
             # tick heartbeat: the watchdog declares the engine hung when
             # this goes stale past tick_deadline (idle passes beat every
             # idle_poll_s, so only a stuck tick can starve it).  Gen
@@ -749,7 +819,22 @@ class EngineRunner:
         # that later dispatches into the yanked pool fails in ITS
         # generation and is ignored.
         old.pool.pages = None
-        engine = old.clone_fresh()
+        with self._sup_lock:
+            pend = self._pending_weights
+        if pend is not None:
+            # a planned weight swap rides the restart machinery: same
+            # drain/replay/zombie-mute discipline, new params.  The
+            # jitted steps take params as ARGUMENTS, so a same-shaped
+            # swap reuses every warm compile; share_from (a peer that
+            # already rolled) makes genuinely-new avals compile once
+            # per fleet
+            new_params, new_version, share_from = pend
+            engine = old.clone_fresh(params=new_params,
+                                     weights_version=new_version)
+            if share_from is not None:
+                engine.share_compiled_steps(share_from)
+        else:
+            engine = old.clone_fresh()
         # mute the zombie's counters: the clone shares the REAL metrics
         # object; a watchdog-superseded-but-alive thread finishing its
         # slow tick would otherwise keep writing on_token/on_finish into
@@ -769,6 +854,10 @@ class EngineRunner:
         # rebuilt engine would corrupt the EWMA baselines
         old.request_log = None
         old.sentinel = None
+        # ...and the action policy: a zombie tick feeding stale signals
+        # would corrupt the streak/burn state the rebuilt engine's
+        # ticks now advance
+        old.actions = None
         with self._sup_lock:
             if gen != self._gen:
                 # superseded DURING the rebuild (it wedged long enough
@@ -776,17 +865,126 @@ class EngineRunner:
                 # owns self.engine) — walk away without touching anything
                 return
             self.engine = engine
+            if pend is not None and self._pending_weights is pend:
+                self._pending_weights = None
 
         for rec in replay:
             if gen != self._gen:
                 return  # superseded mid-replay — the newer thread redoes it
-            self._replay_one(gen, rec)
+            # an upgrade's leftover streams keep generating detached (a
+            # journal-recovered client may attach later); a crash
+            # restart's streams must have a live client
+            self._replay_one(
+                gen, rec,
+                require_live=not rec.pop("detached_ok", False),
+            )
             if gen == self._gen:
                 self._beat = time.monotonic()
         if tr is not None:
             tr.complete("restart", t_restart, cat="supervisor", args={
                 "gen": gen, "replayed": len(replay),
             })
+
+    # -- planned lifecycle (rolling weight swap) -----------------------
+    def detach_inflight(self) -> list[dict]:
+        """Supersede the live tick generation and hand back the
+        in-flight replay snapshot — the first half of a PLANNED swap
+        (upgrade or removal), sharing the crash path's discipline: the
+        old thread goes zombie (gen bump + handback), the snapshot is
+        what peers adopt (drain) or the rebuilt engine replays."""
+        with self._sup_lock:
+            self._gen += 1
+            self.recovering = True
+            self._beat = time.monotonic()
+            # the rebuild includes a params device_put — give the
+            # watchdog the same grace a backoff restart gets
+            self._backoff_delay = max(self._backoff_delay, 10.0)
+            replay = [dict(rec, tokens=list(rec["tokens"]),
+                           deltas=list(rec.get("deltas") or ()))
+                      for rec in self._inflight.values()]
+            self._inflight.clear()
+        self._cmds.put(("wake",))  # unblock an idle superseded thread
+        return replay
+
+    def rebuild_upgraded(self, params: Any, version: int,
+                         replay: list[dict], *,
+                         share_from: Any = None) -> None:
+        """Second half of the swap: spawn the new generation's tick
+        thread, which rebuilds via ``clone_fresh(params=...)`` and
+        replays ``replay`` teacher-forced (token-identical).
+        ``share_from`` is a peer engine that already rolled — its
+        jitted callables are adopted so new-weight avals compile once
+        per FLEET.  Caller ran ``detach_inflight`` first."""
+        with self._sup_lock:
+            if self._stop.is_set():
+                raise RuntimeError("runner is stopped")
+            self._pending_weights = (params, int(version), share_from)
+            new_gen = self._gen
+        self._spawn_thread(new_gen, replay=replay)
+
+    def await_recovered(self, timeout_s: float = 300.0) -> None:
+        """Block until the rebuilt engine completes its first loop pass
+        (``recovering`` clears) — the roll moves to the next replica
+        only once this one is serving again."""
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            if self.crashed:
+                raise RuntimeError(
+                    f"replica crashed during upgrade: {self.crashed}"
+                )
+            if not self.recovering:
+                return
+            time.sleep(0.01)
+        raise TimeoutError(
+            f"upgrade rebuild did not complete within {timeout_s:g}s"
+        )
+
+    def rolling_upgrade(self, params_fn: Any, *,
+                        version: int | None = None,
+                        timeout_s: float = 300.0) -> dict:
+        """The fleet-of-one roll (``POST /admin/upgrade`` on a
+        single-replica server): no peer to drain to, so in-flight
+        streams are replayed IN PLACE on the rebuilt engine —
+        teacher-forced, so delivered tokens never change; tokens still
+        to come are sampled by the new weights (with one replica there
+        is no same-version peer to finish them on, and the request's
+        version tag records its admission version either way)."""
+        from llm_np_cp_tpu.serve.lifecycle import load_upgrade_params
+
+        if not self._upgrade_lock.acquire(blocking=False):
+            raise RuntimeError("a rolling upgrade is already in progress")
+        try:
+            if self.crashed:
+                raise RuntimeError(
+                    f"cannot upgrade a crashed server: {self.crashed}"
+                )
+            params = load_upgrade_params(
+                params_fn, replica=self.replica_index,
+                faults=self.faults, metrics=self.engine.metrics,
+                rolled=[], version=version,
+            )
+            if version is None:
+                version = getattr(self.engine, "weights_version", 0) + 1
+            replay = [dict(rec, detached_ok=True)
+                      for rec in self.detach_inflight()]
+            self.rebuild_upgraded(params, version, replay)
+            try:
+                self.await_recovered(timeout_s)
+            except TimeoutError as e:
+                # surface the same clean abort shape as a checkpoint
+                # failure — the admin handler turns it into a 500
+                # instead of a dropped connection; the supervisor
+                # keeps rebuilding
+                from llm_np_cp_tpu.serve.lifecycle import UpgradeAborted
+
+                raise UpgradeAborted(
+                    f"replica {self.replica_index} rebuild timed out: "
+                    f"{e}", rolled=[], version=version,
+                ) from e
+            self.engine.metrics.on_lifecycle_action("upgrade_replica")
+            return {"rolled": [self.replica_index], "version": version}
+        finally:
+            self._upgrade_lock.release()
 
     def _on_engine_death(self, reason: str, gen: int) -> None:
         """Crash/hang handler (from the dying thread or the watchdog):
@@ -920,9 +1118,17 @@ class HttpServer:
         restart_backoff_s: float = 0.5,
         restart_window_s: float = 300.0,
         runner: Any = None,
+        upgrade_loader: Any = None,
     ) -> None:
         self.engine = engine
         self.model_id = model_id
+        # rolling weight swaps (POST /admin/upgrade): the loader maps
+        # the request body to fresh params (the serve CLI wires a
+        # checkpoint reload); None = the endpoint 404s with a hint.
+        # One admin mutation at a time — a roll and a scale racing
+        # would drain the same peers out from under each other
+        self.upgrade_loader = upgrade_loader
+        self._admin_lock = threading.Lock()
         self.tokenizer = tokenizer if tokenizer is not None \
             else getattr(engine, "tokenizer", None)
         self.drain_timeout = drain_timeout
@@ -1059,6 +1265,8 @@ class HttpServer:
             payload = {
                 "status": state, "model": self.model_id,
                 "restarts": self.runner.restarts,
+                "weights_version": getattr(
+                    self.runner.engine, "weights_version", 0),
             }
             mesh = getattr(self.runner.engine, "mesh_desc", None)
             if mesh:
@@ -1092,6 +1300,18 @@ class HttpServer:
                 body = await asyncio.get_running_loop().run_in_executor(
                     None, lambda: json.dumps(tracer.to_dict()).encode())
                 await self._respond(writer, 200, body)
+        elif path == "/admin/upgrade":
+            if method != "POST":
+                await self._respond_error(writer, HTTPError(
+                    405, "use POST for /admin/upgrade"))
+            else:
+                await self._admin_upgrade(writer, body)
+        elif path == "/admin/scale":
+            if method != "POST":
+                await self._respond_error(writer, HTTPError(
+                    405, "use POST for /admin/scale"))
+            else:
+                await self._admin_scale(writer, body)
         elif path == "/v1/completions":
             if method != "POST":
                 await self._respond_error(writer, HTTPError(
@@ -1180,7 +1400,13 @@ class HttpServer:
         stats = engine.pool.stats()
         faults = self.runner.faults
         recov = self.runner.recovery_latency_s
-        return engine.metrics.prometheus(extra_gauges={
+        wv = getattr(engine, "weights_version", 0)
+        return engine.metrics.prometheus(
+            # the version label appears once an upgrade rolled (wv > 0)
+            # — pre-upgrade series keep their exact labelsets
+            const_labels={"version": str(wv)} if wv else None,
+            extra_gauges={
+            "weights_version": float(wv),
             "pool_blocks_free": stats["free"],
             "pool_blocks_request_held": stats["request_held"],
             "pool_blocks_cache_only": stats["cache_only"],
@@ -1227,6 +1453,134 @@ class HttpServer:
             ]
         await self._respond(writer, 200, json.dumps(body).encode())
 
+    # -- fleet lifecycle admin (serve/lifecycle.py) --------------------
+    async def _admin_upgrade(self, writer: asyncio.StreamWriter,
+                             body: bytes) -> None:
+        """``POST /admin/upgrade``: roll the fleet onto fresh weights,
+        one replica at a time, zero dropped streams.  Body (optional
+        JSON): ``{"model": <checkpoint for the loader>, "version": N}``.
+        Responds after the roll with ``{"rolled": [...], "version"}``;
+        409 when a roll is already in progress, 500 with the rolled
+        prefix when the roll aborted (checkpoint failure — the fleet
+        keeps serving, mixed-version)."""
+        from llm_np_cp_tpu.serve.lifecycle import UpgradeAborted
+
+        if self.upgrade_loader is None:
+            await self._respond_error(writer, HTTPError(
+                404, "no upgrade loader configured; the serve CLI "
+                "wires one (POST /admin/upgrade)"))
+            return
+        try:
+            data = json.loads(body) if body else {}
+            if not isinstance(data, dict):
+                raise ValueError("body must be a JSON object")
+        except ValueError as e:
+            await self._respond_error(writer, HTTPError(
+                400, f"bad JSON body: {e}"))
+            return
+        version = data.get("version")
+        if version is not None and (
+            not isinstance(version, int) or isinstance(version, bool)
+            or version < 1
+        ):
+            await self._respond_error(writer, HTTPError(
+                400, f"version must be a positive integer, "
+                f"got {version!r}"))
+            return
+        if not self._admin_lock.acquire(blocking=False):
+            await self._respond_error(writer, HTTPError(
+                409, "an admin operation is already in progress"))
+            return
+        loader = self.upgrade_loader
+        loop = asyncio.get_running_loop()
+        try:
+            result = await loop.run_in_executor(
+                None,
+                lambda: self.runner.rolling_upgrade(
+                    lambda: loader(data), version=version,
+                ),
+            )
+        except UpgradeAborted as e:
+            await self._respond(writer, 500, json.dumps({
+                "error": str(e), "rolled": e.rolled,
+            }).encode())
+            return
+        except RuntimeError as e:
+            # only a concurrent roll is a Conflict; a crashed/stopped
+            # runner or an empty fleet is the server's unavailability,
+            # and a 409 would invite the client to retry-until-done
+            # against a fleet that can never finish a roll
+            status = 409 if "in progress" in str(e) else 503
+            await self._respond_error(writer, HTTPError(status, str(e)))
+            return
+        finally:
+            self._admin_lock.release()
+        await self._respond(writer, 200, json.dumps(result).encode())
+
+    async def _admin_scale(self, writer: asyncio.StreamWriter,
+                           body: bytes) -> None:
+        """``POST /admin/scale`` ``{"replicas": N}``: elastic DP for
+        the HTTP fleet — grow with warmed share-nothing clones, shrink
+        with drain-to-peer removals."""
+        if getattr(self.runner, "add_replica", None) is None:
+            await self._respond_error(writer, HTTPError(
+                400, "single-engine server cannot scale; start with "
+                "--replicas N"))
+            return
+        try:
+            data = json.loads(body) if body else {}
+            n = data["replicas"]
+            if not isinstance(n, int) or isinstance(n, bool) \
+                    or not (1 <= n <= 64):
+                raise ValueError(f"replicas must be in [1, 64], got {n!r}")
+        except (KeyError, TypeError, ValueError) as e:
+            await self._respond_error(writer, HTTPError(
+                400, f'bad body (want {{"replicas": N}}): {e}'))
+            return
+        if not self._admin_lock.acquire(blocking=False):
+            await self._respond_error(writer, HTTPError(
+                409, "an admin operation is already in progress"))
+            return
+
+        def apply() -> tuple[list[int], list[int]]:
+            added: list[int] = []
+            removed: list[int] = []
+            while self.runner.active_replicas() < n:
+                added.append(self.runner.add_replica())
+            while self.runner.active_replicas() > n:
+                removed.append(self.runner.remove_replica())
+            return added, removed
+
+        loop = asyncio.get_running_loop()
+        try:
+            added, removed = await loop.run_in_executor(None, apply)
+        except RuntimeError as e:
+            await self._respond_error(writer, HTTPError(400, str(e)))
+            return
+        finally:
+            self._admin_lock.release()
+        await self._respond(writer, 200, json.dumps({
+            "replicas": self.runner.active_replicas(),
+            "added": added, "removed": removed,
+            "states": self.runner.replica_states(),
+        }).encode())
+
+    def _shed_retry_after(self) -> float | None:
+        """503-first load shedding: the max Retry-After across SERVING
+        replicas whose ActionPolicy is shedding, or None when admission
+        is open.  Only serving replicas vote (``serving_engines`` —
+        removed/crashed replicas' tick threads can never release a shed
+        flag, and a frozen verdict must not shed the fleet forever).
+        Racy boolean reads by design (like the routing load reads) —
+        one request admitted a tick early or late is noise."""
+        worst = None
+        for engine in self.runner.serving_engines():
+            acts = getattr(engine, "actions", None)
+            if acts is not None and acts.shedding:
+                ra = acts.retry_after()
+                worst = ra if worst is None else max(worst, ra)
+        return worst
+
     # ------------------------------------------------------------------
     async def _completions(self, reader: asyncio.StreamReader,
                            writer: asyncio.StreamWriter,
@@ -1263,6 +1617,20 @@ class HttpServer:
                 rid, last_idx, echo_model = resume
                 await self._resume(reader, writer, rid, last_idx,
                                    echo_model, t_accept)
+                return
+            # 503-first load shedding (serve/lifecycle.ActionPolicy):
+            # when the SLO error budget burns past threshold, FRESH
+            # admissions shed at the door with a burn-scaled
+            # Retry-After — resumes above attach to work already done
+            # and always pass
+            shed = self._shed_retry_after()
+            if shed is not None:
+                await self._respond_error(writer, HTTPError(
+                    503, "load shedding: SLO error budget is burning "
+                    "past threshold; retry later",
+                    etype="server_error",
+                    headers=(("Retry-After", f"{shed:g}"),),
+                ))
                 return
             payload = parse_completion_request(
                 body, model_id=self.model_id, tokenizer=self.tokenizer,
@@ -1571,6 +1939,7 @@ async def run_server(
     exit_after_s: float | None = None,
     on_started: Any = None,
     runner: Any = None,
+    upgrade_loader: Any = None,
 ) -> HttpServer:
     """Start serving and block until drain shutdown completes."""
     server = HttpServer(
@@ -1582,6 +1951,7 @@ async def run_server(
         restart_backoff_s=restart_backoff_s,
         restart_window_s=restart_window_s,
         runner=runner,
+        upgrade_loader=upgrade_loader,
     )
     await server.start(host, port)
     if port_file:

@@ -144,6 +144,10 @@ def _apply(state: dict[int, dict], rec: dict) -> int | None:
             # spec-enabled engine resumes drafting (tokens are identical
             # either way — this only preserves the throughput mode)
             "spec": bool(rec.get("spec", False)),
+            # the weight version the request was ADMITTED under: a
+            # replay (possibly onto a rolled engine) keeps reporting
+            # the version that actually served the stream
+            "wv": int(rec.get("wv", 0)),
         }
     elif t == "wm":
         for rid, n, toks in rec["rows"]:
@@ -299,6 +303,11 @@ class RequestJournal:
                 rec[key] = int(val)
         if getattr(req, "speculative", False):
             rec["spec"] = True
+        # the serving weight version (rolling-upgrade tagging): written
+        # only when nonzero, so pre-upgrade journals stay byte-stable
+        wv = req.extra.get("weights_version")
+        if wv:
+            rec["wv"] = int(wv)
         self._enqueue(rec)
         if self.sync_admissions:
             # block the enqueuing (engine) thread until the writer has
@@ -468,6 +477,8 @@ class RequestJournal:
                             rec[key] = ent[key]
                     if ent.get("spec"):
                         rec["spec"] = True
+                    if ent.get("wv"):
+                        rec["wv"] = ent["wv"]
                     f.write(_frame(rec))
                 f.flush()
                 if self.fsync:

@@ -102,6 +102,10 @@ class ServeMetrics:
         # ServeEngine._sentinel_observe): per-phase outlier counts,
         # exported as llm_serve_anomaly_ticks_total{phase=}
         self.anomaly_ticks: Counter[str] = Counter()
+        # fleet lifecycle events (serve/lifecycle.ActionPolicy flips,
+        # rolling upgrades, elastic add/remove), exported as
+        # llm_serve_lifecycle_actions_total{action=}
+        self.lifecycle_actions: Counter[str] = Counter()
         # bounded-retention mode for long-running servers: None (bench/
         # test traces — exact full-trace percentiles) keeps every sample;
         # an int caps each value list, dropping the oldest half on
@@ -206,6 +210,14 @@ class ServeMetrics:
         """The tick sentinel named ``phase`` as an outlier this tick."""
         with self._lock:
             self.anomaly_ticks[phase] += 1
+
+    def on_lifecycle_action(self, action: str) -> None:
+        """One fleet lifecycle event: an ActionPolicy flip
+        (shed_prefill_on/off, shed_load_on/off), a rolled replica
+        (upgrade_replica), an aborted roll, or an elastic
+        add/remove_replica."""
+        with self._lock:
+            self.lifecycle_actions[action] += 1
 
     def on_spec(self, *, drafted: int, accepted: int) -> None:
         """One speculative verify round for one request: ``drafted``
@@ -334,6 +346,8 @@ class ServeMetrics:
                 out.update(self.slo.snapshot())
             if self.anomaly_ticks:
                 out["anomaly_ticks"] = dict(self.anomaly_ticks)
+            if self.lifecycle_actions:
+                out["lifecycle_actions"] = dict(self.lifecycle_actions)
         out.update(_pcts(ttft, "ttft_s"))
         out.update(_pcts(decode, "decode_tok_s"))
         out.update(_pcts(qwait, "queue_wait_s"))
@@ -494,6 +508,13 @@ class ServeMetrics:
                  "outlier vs its rolling baseline",
                  [(f'{{phase="{p}"}}', n)
                   for p, n in sorted(s["anomaly_ticks"].items())])
+        if s.get("lifecycle_actions"):
+            emit("lifecycle_actions_total", "counter",
+                 "Fleet lifecycle events: auto-action flips "
+                 "(shed_prefill/shed_load on/off), rolled replicas, "
+                 "elastic add/remove",
+                 [(f'{{action="{a}"}}', n)
+                  for a, n in sorted(s["lifecycle_actions"].items())])
         # -- real histograms: cumulative _bucket/_sum/_count from the
         # incrementally-maintained counters (exact forever, unlike the
         # trimmed percentile windows; aggregable across replicas)

@@ -39,6 +39,7 @@ from __future__ import annotations
 import hashlib
 import itertools
 import math
+import threading
 from typing import Any, Callable
 
 import numpy as np
@@ -49,6 +50,39 @@ from llm_np_cp_tpu.serve.scheduler import Request
 
 def _ceil_to(n: int, g: int) -> int:
     return -(-n // g) * g
+
+
+def _fresh_replica_engine(src: Any) -> Any:
+    """A warmed NEW replica cloned from ``src`` (elastic
+    ``add_replica``): same geometry and params, compiled steps shared
+    (``clone_fresh`` + ``share_compiled_steps`` — joining the fleet
+    compiles nothing), but share-NOTHING observability: its own
+    metrics/SLO tracker/sentinel/ActionPolicy (those are per-tick-thread
+    state; the restart path shares them because a restart IS the same
+    replica) and no journal (a journal segment is a per-path resource
+    the caller wires explicitly)."""
+    from llm_np_cp_tpu.serve.metrics import ServeMetrics
+
+    eng = src.clone_fresh()
+    eng.share_compiled_steps(src)
+    eng.journal = None
+    metrics = ServeMetrics(clock=src.clock)
+    slo = getattr(src.metrics, "slo", None)
+    if slo is not None:
+        from llm_np_cp_tpu.serve.slo import SLOTracker
+
+        metrics.slo = SLOTracker(slo.policy, clock=slo.clock)
+    eng.metrics = metrics
+    sent = src.sentinel
+    if sent is not None:
+        from llm_np_cp_tpu.serve.slo import TickSentinel
+
+        eng.sentinel = TickSentinel(
+            alpha=sent.alpha, threshold=sent.threshold,
+            warmup_ticks=sent.warmup_ticks, min_us=sent.min_us,
+        )
+    eng.actions = None if src.actions is None else src.actions.spawn()
+    return eng
 
 
 class PrefixRouter:
@@ -173,6 +207,20 @@ class PrefixRouter:
         for k in dead:
             del self._sticky[k]
         return len(dead)
+
+    def grow(self, n: int) -> None:
+        """Widen the candidate set to ``n`` replicas (elastic
+        ``add_replica`` — the new index starts cold and picks up
+        traffic first-sight by least-loaded assignment).  Shrinking is
+        never an index operation: a removed replica keeps its slot and
+        just leaves the ``alive`` mask, so sticky entries and owner
+        maps stay valid."""
+        if n < self.n:
+            raise ValueError(
+                f"router cannot shrink ({self.n} -> {n}); removal is "
+                "an alive-mask change, not an index change"
+            )
+        self.n = n
 
 
 def _check_homogeneous(engines: list) -> None:
@@ -321,23 +369,271 @@ class ReplicaSet:
         serving between ``kill_replica`` and this call — nothing here
         touches them."""
         old = self.engines[idx]
-        inflight = sorted(old._requests.values(), key=lambda r: r.req_id)
         engine = old.clone_fresh()
-        for req in inflight:
-            if len(req.generated) >= req.max_new_tokens:
-                engine.finish_recovered(
-                    req.prompt, req.max_new_tokens, request_id=req.req_id,
-                    generated=req.generated, reason="length",
-                )
-                continue
-            engine.recover(
-                req.prompt, req.max_new_tokens, request_id=req.req_id,
-                seed=req.seed, generated=list(req.generated),
-                callback=req.callback, on_event=req.on_event,
-                deadline_at=req.deadline,
-            )
+        # the same adoption body as a drain/roll (_adopt_recovered):
+        # an in-flight request whose tokens already reached its budget
+        # (or a stop token) moves straight to the `finished` ledger
+        # with its terminal event delivered — not just counted
+        self._replay_in_place(old, engine)
+        # terminal history survives the rebuild: the fleet's `finished`
+        # ledger (and the parity checks reading it) must keep the
+        # requests this replica completed BEFORE it died
+        engine.scheduler.finished.extend(old.scheduler.finished)
+        engine.scheduler.aborted.extend(old.scheduler.aborted)
         self.engines[idx] = engine
         self.alive[idx] = True
+
+    # -- fleet lifecycle: rolling upgrade + elastic DP -----------------
+    def _drain_to_peers(self, idx: int, *,
+                        prefer_version: int | None = None) -> list[int]:
+        """Move replica ``idx``'s in-flight requests onto live peers —
+        the PR 9 drain-to-peer discipline driven synchronously: each
+        request re-routes through the router AFTER ``idx``'s sticky
+        prefixes were forgotten, is replayed teacher-forced on the peer
+        (token-identical — deterministic (seed, content-pos) keys), and
+        keeps its admission-time ``weights_version`` tag.  With
+        ``prefer_version`` set (a mid-roll drain), peers still on that
+        weight version are preferred so a stream is served end-to-end
+        by one version whenever such a peer exists; when none is left
+        (the last old-version replica draining), any live peer adopts
+        it — the tag still reports the admission version.  Caller has
+        already marked ``idx`` dead and forgotten its prefixes."""
+        alive = list(self.alive)
+        if prefer_version is not None:
+            same = [
+                ok and self.engines[i].weights_version == prefer_version
+                for i, ok in enumerate(alive)
+            ]
+            if any(same):
+                alive = same
+        stops = tuple(self.engines[idx].stop_tokens or ())
+        # the draining replica's journal segment must terminate each
+        # moved stream (the peer's recover() re-admits it into the
+        # peer's segment) — otherwise a restart scanning both segments
+        # replays it twice.  Same rule as the HTTP fleet's _drain_dead.
+        src_journal = getattr(self.engines[idx], "journal", None)
+        drained: list[int] = []
+        inflight = sorted(
+            self.engines[idx]._requests.values(), key=lambda r: r.req_id
+        )
+        for req in inflight:
+            key, _ = self.router.affinity_chain(req.prompt)
+            peer, _ = self.router.route(
+                key, loads=self._loads(),
+                queue_depths=self._queue_depths(), alive=alive,
+            )
+            engine = self.engines[peer]
+            lineage = {
+                "replays": int(req.extra.get("replays", 0)),
+                "drains": int(req.extra.get("drains", 0)) + 1,
+            }
+            tracer = getattr(engine, "tracer", None)
+            if tracer is not None:
+                tracer.request_instant(req.req_id, "drain-to-peer", args={
+                    "trace": req.extra.get("trace"),
+                    "from_replica": idx, "to_replica": peer,
+                })
+            self._adopt_recovered(engine, req, lineage=lineage,
+                                  stops=stops)
+            if src_journal is not None:
+                src_journal.terminal(req.req_id, "drained")
+            self._owner[req.req_id] = peer
+            drained.append(req.req_id)
+        return drained
+
+    def _adopt_recovered(self, engine: Any, req: Any, *,
+                         lineage: dict[str, int],
+                         stops: tuple[int, ...]) -> None:
+        """The ONE done/stopped/recover adoption body shared by
+        ``_drain_to_peers`` and ``_replay_in_place``: a fully generated
+        stream moves only its terminal bookkeeping (the fleet's
+        ``finished`` ledger reads scheduler state, so a drained-terminal
+        request must appear there like any other finish, and the
+        client's final event carries the remaining text); anything else
+        is replayed teacher-forced through ``recover`` with its lineage
+        and admission-time ``weights_version`` tag."""
+        wv = req.extra.get("weights_version")
+        tokens = list(req.generated)
+        done = len(tokens) >= req.max_new_tokens
+        stopped = bool(tokens) and tokens[-1] in stops
+        if done or stopped:
+            reason = "stop" if stopped else "length"
+            tail = engine.finish_recovered(
+                req.prompt, req.max_new_tokens,
+                request_id=req.req_id, generated=tokens,
+                reason=reason,
+                trace_id=req.extra.get("trace"), lineage=lineage,
+                weights_version=wv,
+            )
+            req.finish_reason = reason
+            engine.scheduler.finished.append(req)
+            if req.on_event is not None:
+                req.extra["final_text_delta"] = tail
+                req.on_event(req, reason)
+        else:
+            engine.recover(
+                req.prompt, req.max_new_tokens,
+                request_id=req.req_id, seed=req.seed,
+                generated=tokens, callback=req.callback,
+                on_event=req.on_event, deadline_at=req.deadline,
+                trace_id=req.extra.get("trace"), lineage=lineage,
+                speculative=req.speculative, weights_version=wv,
+            )
+
+    def _replay_in_place(self, old: Any, engine: Any) -> int:
+        """Fleet-of-one roll: no peer to drain to, so the rebuilt
+        engine replays its own in-flight streams teacher-forced —
+        delivered tokens never change; tokens still to come sample
+        from the new weights (there is no same-version peer to finish
+        them on, and the request's version tag records its admission
+        version either way)."""
+        stops = tuple(old.stop_tokens or ())
+        n = 0
+        for req in sorted(old._requests.values(),
+                          key=lambda r: r.req_id):
+            lineage = {
+                "replays": int(req.extra.get("replays", 0)) + 1,
+                "drains": int(req.extra.get("drains", 0)),
+            }
+            self._adopt_recovered(engine, req, lineage=lineage,
+                                  stops=stops)
+            n += 1
+        return n
+
+    def rolling_upgrade(self, params_fn: Callable[[], Any], *,
+                        version: int | None = None,
+                        steps_between: int = 1) -> dict[str, Any]:
+        """Swap the fleet onto fresh weights with zero downtime: one
+        replica at a time is drained to its peers (in-flight streams
+        complete token-identically there), rebuilt on ``params_fn()``'s
+        weights via ``clone_fresh(params=...)``, and returned to
+        routing; ``steps_between`` fleet ticks run after each swap so
+        traffic keeps flowing mid-roll.
+
+        Compile discipline (pinned by tests + the compile_counter
+        section): the first rolled replica keeps its own jitted step
+        callables (params are call arguments — same-shaped weights
+        reuse every warm compile, different avals re-trace once), and
+        every later rolled replica adopts the first one's callables via
+        ``share_compiled_steps`` — new weights are jitted once per
+        FLEET, never per replica.
+
+        A checkpoint failure (``params_fn`` raising, or the
+        ``upgrade_ckpt`` chaos site) aborts the roll CLEANLY with
+        ``UpgradeAborted``: the replica being rolled was not yet
+        drained, so it stays live on its old weights and the fleet
+        never drops below N-1 capacity.  Replicas already rolled stay
+        on the new weights (the version tag says which weights served
+        each request)."""
+        from llm_np_cp_tpu.serve.lifecycle import (
+            cache_params_fn,
+            load_upgrade_params,
+        )
+
+        order = [i for i, ok in enumerate(self.alive) if ok]
+        if not order:
+            raise RuntimeError("no alive replica to upgrade")
+        if version is None:
+            version = max(e.weights_version for e in self.engines) + 1
+        params_once = cache_params_fn(params_fn)
+        rolled: list[int] = []
+        drained_total = 0
+        first_rolled: Any = None
+        for idx in order:
+            old = self.engines[idx]
+            params = load_upgrade_params(
+                params_once, replica=idx, faults=old.faults,
+                metrics=old.metrics, rolled=rolled, version=version,
+            )
+            old_version = old.weights_version
+            self.alive[idx] = False
+            self.router.forget_replica(idx)
+            # fleet of one (or every peer already dead): nothing to
+            # drain TO — the rebuilt engine replays its own streams in
+            # place instead (the EngineRunner fleet-of-one discipline)
+            had_peer = any(self.alive)
+            drained = (
+                self._drain_to_peers(idx, prefer_version=old_version)
+                if had_peer else []
+            )
+            drained_total += len(drained)
+            engine = old.clone_fresh(params=params,
+                                     weights_version=version)
+            if first_rolled is None:
+                first_rolled = engine
+            else:
+                engine.share_compiled_steps(first_rolled)
+            if not had_peer:
+                self._replay_in_place(old, engine)
+            engine.scheduler.finished.extend(old.scheduler.finished)
+            engine.scheduler.aborted.extend(old.scheduler.aborted)
+            self.engines[idx] = engine
+            self.alive[idx] = True
+            engine.metrics.on_lifecycle_action("upgrade_replica")
+            tracer = getattr(engine, "tracer", None)
+            if tracer is not None:
+                tracer.instant("upgrade-replica", cat="lifecycle", args={
+                    "replica": idx, "version": version,
+                    "drained": len(drained),
+                })
+            rolled.append(idx)
+            for _ in range(steps_between):
+                self.step()
+        return {
+            "rolled": rolled, "version": version,
+            "drained": drained_total,
+        }
+
+    def add_replica(self, engine: Any = None) -> int:
+        """Grow the fleet at runtime: a warmed clone of a live replica
+        (compiled steps shared — joining compiles nothing; fresh
+        metrics/sentinel/policy — per-thread state is never shared
+        across replicas), appended under a new index the router starts
+        routing to first-sight.  Returns the new replica index."""
+        src_idx = next(
+            (i for i, ok in enumerate(self.alive) if ok), None)
+        if src_idx is None:
+            raise RuntimeError("no alive replica to clone from")
+        if engine is None:
+            engine = _fresh_replica_engine(self.engines[src_idx])
+        _check_homogeneous([self.engines[src_idx], engine])
+        self.engines.append(engine)
+        self.alive.append(True)
+        idx = len(self.engines) - 1
+        self.router.grow(len(self.engines))
+        self._next_id = max(self._next_id, engine._next_id)
+        engine.metrics.on_lifecycle_action("add_replica")
+        tracer = getattr(engine, "tracer", None)
+        if tracer is not None:
+            tracer.instant("add-replica", cat="lifecycle",
+                           args={"replica": idx})
+        return idx
+
+    def remove_replica(self, idx: int) -> list[int]:
+        """Shrink the fleet at runtime — the SIGTERM-style drain: the
+        replica leaves routing, its sticky prefixes re-home, and every
+        in-flight stream is adopted by a peer (teacher-forced, token-
+        identical).  The engine object keeps its slot (indices are
+        stable forever; ``alive`` is the membership mask) so its
+        terminal history stays readable.  Returns the drained request
+        ids."""
+        if not (0 <= idx < len(self.engines)) or not self.alive[idx]:
+            raise ValueError(f"replica {idx} is not an alive replica")
+        if sum(self.alive) < 2:
+            raise RuntimeError(
+                "cannot remove the last alive replica — scale-down "
+                "floor is 1"
+            )
+        self.alive[idx] = False
+        self.router.forget_replica(idx)
+        drained = self._drain_to_peers(idx)
+        self.engines[idx].metrics.on_lifecycle_action("remove_replica")
+        tracer = getattr(self.engines[idx], "tracer", None)
+        if tracer is not None:
+            tracer.instant("remove-replica", cat="lifecycle", args={
+                "replica": idx, "drained": len(drained),
+            })
+        return drained
 
     # -- aggregate observability ---------------------------------------
     def snapshot(self) -> dict[str, Any]:
@@ -349,6 +645,10 @@ class ReplicaSet:
         out: dict[str, Any] = {
             "replicas": per,
             "n_replicas": len(self.engines),
+            "alive_replicas": sum(1 for a in self.alive if a),
+            "weights_versions": [
+                e.weights_version for e in self.engines
+            ],
             "router_routed": self.router.routed,
             "router_spilled": self.router.spilled,
         }
@@ -426,14 +726,16 @@ class ReplicaRunner:
         from llm_np_cp_tpu.serve.http.server import EngineRunner
 
         _check_homogeneous(engines)
+        # supervision config, kept so an elastic add_replica builds its
+        # runner with the SAME watchdog/restart policy as the founders
+        self._supervision = dict(
+            request_timeout=request_timeout,
+            tick_deadline=tick_deadline, max_restarts=max_restarts,
+            restart_backoff_s=restart_backoff_s,
+            restart_window_s=restart_window_s,
+        )
         self.replicas = [
-            EngineRunner(
-                e, request_timeout=request_timeout,
-                tick_deadline=tick_deadline, max_restarts=max_restarts,
-                restart_backoff_s=restart_backoff_s,
-                restart_window_s=restart_window_s,
-            )
-            for e in engines
+            EngineRunner(e, **self._supervision) for e in engines
         ]
         for i, runner in enumerate(self.replicas):
             # fleet drain: a replica going terminally dark hands its
@@ -463,6 +765,16 @@ class ReplicaRunner:
                 default=-1) + 1,
         ))
         self._dead: set[int] = set()  # replicas whose death was forgotten
+        # lifecycle membership: replicas mid-upgrade (back after the
+        # swap) and replicas removed for good — both leave routing;
+        # indices are stable forever, `alive` is the membership mask.
+        # Mutated only by the admin/lifecycle thread, read racily by
+        # submit-time routing (a set membership read is GIL-atomic and
+        # one stale verdict just routes one request to a replica that
+        # immediately drains it — harmless, like the load reads)
+        self._lifecycle: set[int] = set()
+        self._removed: set[int] = set()
+        self._upgrade_lock = threading.Lock()
 
     # -- the EngineRunner interface ------------------------------------
     @property
@@ -505,11 +817,16 @@ class ReplicaRunner:
     @property
     def crashed(self) -> str | None:
         """Terminal only when the WHOLE fleet is dark — a single crashed
-        replica is a degradation the router routes around."""
-        downs = [r.crashed for r in self.replicas]
-        if all(downs):
+        replica is a degradation the router routes around.  Replicas
+        removed by elastic scale-down left the fleet on purpose and do
+        not count either way."""
+        downs = {
+            i: r.crashed for i, r in enumerate(self.replicas)
+            if i not in self._removed
+        }
+        if downs and all(downs.values()):
             return "; ".join(
-                f"replica {i}: {c}" for i, c in enumerate(downs)
+                f"replica {i}: {c}" for i, c in sorted(downs.items())
             )
         return None
 
@@ -526,22 +843,39 @@ class ReplicaRunner:
         return [
             {
                 "replica": i,
-                "state": r.state,
+                "state": (
+                    "removed" if i in self._removed
+                    else "upgrading" if i in self._lifecycle
+                    else r.state
+                ),
                 "restarts": r.restarts,
                 "inflight": r.inflight,
+                "weights_version": getattr(r.engine, "weights_version", 0),
                 "mesh": getattr(r.engine, "mesh_desc", None),
             }
             for i, r in enumerate(self.replicas)
         ]
 
+    def _routable(self, i: int) -> bool:
+        """May the router place NEW work on replica ``i``?  Not crashed,
+        not removed, not mid-upgrade."""
+        return (
+            self.replicas[i].crashed is None
+            and i not in self._removed
+            and i not in self._lifecycle
+        )
+
     def _alive(self) -> list[bool]:
-        alive = [r.crashed is None for r in self.replicas]
-        for i, ok in enumerate(alive):
+        alive = []
+        for i, r in enumerate(self.replicas):
+            ok = r.crashed is None
             if not ok and i not in self._dead:
                 # first sight of a terminal crash: its sticky prefixes
                 # re-home to survivors
                 self._dead.add(i)
                 self.router.forget_replica(i)
+            alive.append(ok and i not in self._removed
+                         and i not in self._lifecycle)
         return alive
 
     def submit(self, rid: int, payload: Any, loop: Any, aq: Any) -> None:
@@ -595,11 +929,13 @@ class ReplicaRunner:
         unknown rid probes each replica's ledger/parked set (the
         journal segments replayed into their own replicas)."""
         idx = self._owner.get(rid)
-        if idx is None or self.replicas[idx].crashed:
+        if idx is None or self.replicas[idx].crashed \
+                or idx in self._removed:
             idx = next(
                 (i for i, r in enumerate(self.replicas)
-                 if r.crashed is None
-                 and (rid in r._inflight or rid in r._resumable)),
+                 if r.crashed is None and i not in self._removed
+                 and (rid in r._inflight or rid in r._resumable
+                      or rid in r._claimed)),
                 None,
             )
         if idx is None:
@@ -609,7 +945,8 @@ class ReplicaRunner:
         self._owner[rid] = idx
         self.replicas[idx].resume(rid, last_idx, loop, aq)
 
-    def _drain_dead(self, dead_idx: int, replay: list[dict]) -> set[int]:
+    def _drain_dead(self, dead_idx: int, replay: list[dict], *,
+                    prefer_version: int | None = None) -> set[int]:
         """A replica went terminally dark: adopt its unterminated
         streams onto live peers — each request re-routes through the
         router AFTER its sticky prefixes are forgotten, so a stream
@@ -618,11 +955,23 @@ class ReplicaRunner:
         moves so the client never sees more than a pause.  The dead
         replica's journal gets a ``drained`` terminal per adopted
         request, so a later process restart does not replay it twice.
+        With ``prefer_version`` set (a mid-roll drain), peers still on
+        that weight version are preferred so a stream is served
+        end-to-end by one version whenever such a peer exists — same
+        rule as the direct-mode ``ReplicaSet._drain_to_peers``.
         Returns the adopted rids (the dead runner abort-flushes the
         rest).  Runs on the dying replica's supervisor thread."""
         dead = self.replicas[dead_idx]
-        alive = [i != dead_idx and r.crashed is None
-                 for i, r in enumerate(self.replicas)]
+        alive = [i != dead_idx and self._routable(i)
+                 for i in range(len(self.replicas))]
+        if prefer_version is not None:
+            same = [
+                ok and getattr(self.replicas[i].engine,
+                               "weights_version", 0) == prefer_version
+                for i, ok in enumerate(alive)
+            ]
+            if any(same):
+                alive = same
         if not any(alive):
             return set()
         self._dead.add(dead_idx)
@@ -666,6 +1015,187 @@ class ReplicaRunner:
                   file=sys.stderr)
         return adopted
 
+    # -- fleet lifecycle: rolling upgrade + elastic DP -----------------
+    def active_replicas(self) -> int:
+        return sum(
+            1 for i, r in enumerate(self.replicas)
+            if r.crashed is None and i not in self._removed
+        )
+
+    def serving_engines(self) -> list:
+        """Engines whose ActionPolicy verdicts may govern admission:
+        routable replicas only — a removed or crashed replica's tick
+        thread can never release a shed flag, so its frozen verdict
+        must not shed the fleet forever."""
+        return [
+            self.replicas[i].engine
+            for i in range(len(self.replicas)) if self._routable(i)
+        ]
+
+    def rolling_upgrade(self, params_fn: Callable[[], Any], *,
+                        version: int | None = None,
+                        timeout_s: float = 300.0) -> dict[str, Any]:
+        """The HTTP fleet's zero-downtime weight swap (the engine-level
+        mechanics live in ``ReplicaSet.rolling_upgrade``'s docstring;
+        this is the supervised-runner spelling): per replica — leave
+        routing, supersede the tick generation, hand the in-flight
+        replay snapshot to live peers through the PR 9 drain path
+        (``_drain_dead``: bridge entries move, streams continue
+        token-identically, ``drained`` terminals land in this replica's
+        journal), rebuild the engine on the new weights on a fresh tick
+        thread (``EngineRunner.rebuild_upgraded`` — clone_fresh, steps
+        shared once per fleet), wait for its first loop pass, rejoin
+        routing.  Serialized by ``_upgrade_lock`` — exactly one roll at
+        a time.  Runs OFF the event loop (the ``POST /admin/upgrade``
+        handler dispatches it to an executor thread)."""
+        from llm_np_cp_tpu.serve.lifecycle import (
+            cache_params_fn,
+            load_upgrade_params,
+        )
+
+        if not self._upgrade_lock.acquire(blocking=False):
+            raise RuntimeError("a rolling upgrade is already in progress")
+        try:
+            order = [i for i in range(len(self.replicas))
+                     if self._routable(i)]
+            if not order:
+                raise RuntimeError("no live replica to upgrade")
+            if version is None:
+                version = max(
+                    getattr(r.engine, "weights_version", 0)
+                    for r in self.replicas
+                ) + 1
+            params_once = cache_params_fn(params_fn)
+            rolled: list[int] = []
+            shared_src: Any = None
+            for idx in order:
+                runner = self.replicas[idx]
+                params = load_upgrade_params(
+                    params_once, replica=idx, faults=runner.faults,
+                    metrics=runner.engine.metrics, rolled=rolled,
+                    version=version,
+                )
+                self._lifecycle.add(idx)
+                try:
+                    old_version = getattr(
+                        runner.engine, "weights_version", 0)
+                    self.router.forget_replica(idx)
+                    replay = runner.detach_inflight()
+                    adopted = self._drain_dead(
+                        idx, replay, prefer_version=old_version)
+                    leftover = [
+                        dict(rec, detached_ok=True) for rec in replay
+                        if rec["rid"] not in adopted
+                    ]
+                    runner.rebuild_upgraded(
+                        params, version, leftover,
+                        share_from=shared_src,
+                    )
+                    try:
+                        runner.await_recovered(timeout_s)
+                    except TimeoutError as e:
+                        # the rebuild wedged — surface the same clean
+                        # abort shape as a checkpoint failure (the
+                        # rolled prefix serves on new weights, this
+                        # replica's supervisor keeps trying)
+                        from llm_np_cp_tpu.serve.lifecycle import (
+                            UpgradeAborted,
+                        )
+                        raise UpgradeAborted(
+                            f"replica {idx} rebuild timed out: {e}",
+                            rolled=rolled, version=version,
+                        ) from e
+                finally:
+                    self._lifecycle.discard(idx)
+                    # _drain_dead marked it dead-and-forgotten; it is
+                    # back, and a FUTURE crash must re-forget
+                    self._dead.discard(idx)
+                if shared_src is None:
+                    shared_src = runner.engine
+                runner.engine.metrics.on_lifecycle_action(
+                    "upgrade_replica")
+                rolled.append(idx)
+            return {"rolled": rolled, "version": version}
+        finally:
+            self._upgrade_lock.release()
+
+    def add_replica(self) -> int:
+        """Grow the HTTP fleet at runtime: a warmed share-nothing clone
+        of a live replica behind its own supervised ``EngineRunner``,
+        routed to first-sight.  Returns the new index."""
+        src_idx = next(
+            (i for i in range(len(self.replicas)) if self._routable(i)),
+            None,
+        )
+        if src_idx is None:
+            raise RuntimeError("no live replica to clone from")
+        from llm_np_cp_tpu.serve.http.server import EngineRunner
+
+        engine = _fresh_replica_engine(self.replicas[src_idx].engine)
+        runner = EngineRunner(engine, **self._supervision)
+        idx = len(self.replicas)
+        from functools import partial
+
+        runner.on_terminal_crash = partial(self._drain_dead, idx)
+        runner.replica_index = idx
+        self.replicas.append(runner)
+        self.router.grow(len(self.replicas))
+        runner.start()
+        engine.metrics.on_lifecycle_action("add_replica")
+        return idx
+
+    def remove_replica(self, idx: int | None = None) -> int:
+        """Shrink the HTTP fleet at runtime — the SIGTERM-style drain:
+        the replica leaves routing, its prefixes re-home, its in-flight
+        streams are adopted by peers through the drain path (clients
+        see a pause, then the peer's token-identical continuation), and
+        its runner stops.  The slot stays (stable indices); ``idx``
+        defaults to the highest-index active replica."""
+        if idx is None:
+            idx = max(
+                (i for i in range(len(self.replicas))
+                 if self._routable(i)), default=-1,
+            )
+        if idx < 0 or idx >= len(self.replicas) \
+                or not self._routable(idx):
+            raise ValueError(f"replica {idx} is not an active replica")
+        if self.active_replicas() < 2:
+            raise RuntimeError(
+                "cannot remove the last active replica — scale-down "
+                "floor is 1"
+            )
+        runner = self.replicas[idx]
+        # count the action on a SURVIVOR's metrics: render_metrics
+        # skips removed replicas, so a counter on the removed engine
+        # would vanish from the scrape the moment the action lands
+        survivor = next(
+            i for i in range(len(self.replicas))
+            if i != idx and self._routable(i)
+        )
+        self.replicas[survivor].engine.metrics.on_lifecycle_action(
+            "remove_replica")
+        self._removed.add(idx)
+        self.router.forget_replica(idx)
+        replay = runner.detach_inflight()
+        adopted = self._drain_dead(idx, replay)
+        # streams no peer adopted (all peers died between the check and
+        # the drain): flush them with a clean terminal instead of
+        # leaving clients hanging, and terminate them in the journal
+        # segment too — otherwise a restart on the same path would
+        # replay streams whose clients already saw 'aborted'
+        journal = runner.journal
+        for rec in replay:
+            rid = rec["rid"]
+            if rid not in adopted and rid in runner._live:
+                runner._push(rid, ("finish", "aborted", None))
+                runner._live.pop(rid, None)
+                if journal is not None:
+                    journal.terminal(rid, "aborted")
+        with runner._sup_lock:
+            runner.recovering = False
+        runner.stop(timeout=10.0)
+        return idx
+
     # -- scrape rendering ----------------------------------------------
     def render_metrics(self, extra_gauges: dict[str, float] | None = None,
                        ) -> str:
@@ -677,10 +1207,16 @@ class ReplicaRunner:
         blocks: list[str] = []
         seen_meta: set[str] = set()
         for i, runner in enumerate(self.replicas):
+            if i in self._removed:
+                # a removed replica's frozen counters would read as a
+                # stalled replica on a dashboard; it left on purpose
+                continue
             engine = runner.engine
             stats = engine.pool.stats()
             recov = runner.recovery_latency_s
+            wv = getattr(engine, "weights_version", 0)
             per_gauges = {
+                "weights_version": float(wv),
                 "pool_blocks_free": stats["free"],
                 "pool_blocks_request_held": stats["request_held"],
                 "pool_blocks_cache_only": stats["cache_only"],
@@ -705,9 +1241,15 @@ class ReplicaRunner:
                         jstats["write_errors"] + jstats["fsync_errors"]),
                     "journal_epoch": float(jstats["epoch"]),
                 })
+            const = {"replica": str(i)}
+            if wv:
+                # the version label appears once a replica has rolled:
+                # mid-roll the scrape shows both versions side by side,
+                # and pre-upgrade series keep their exact labelsets
+                const["version"] = str(wv)
             text = engine.metrics.prometheus(
                 extra_gauges=per_gauges,
-                const_labels={"replica": str(i)},
+                const_labels=const,
             )
             lines = []
             for line in text.splitlines():

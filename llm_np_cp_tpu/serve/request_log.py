@@ -9,7 +9,8 @@ needs in one place:
 - identity      — ``rid``, the W3C ``trace`` id (the SAME id across
   replicas, restarts, and drains), wall ``ts``;
 - routing       — ``replica``, whether the router ``spilled`` it off
-  its prefix-affine replica;
+  its prefix-affine replica, and the ``weights_version`` that admitted
+  (and serves) the request — ONE version per line, drains included;
 - reuse         — prompt length, ``prefix_blocks`` claimed from the
   prefix cache;
 - survival      — ``preemptions`` (evict-requeue), ``replays``
@@ -61,6 +62,9 @@ def request_record(
         "reason": reason,
         "replica": int(extra.get("replica", 0)),
         "spilled": bool(extra.get("spilled", False)),
+        # the ONE weight version that served this request end-to-end
+        # (stamped at admission; drains/replays preserve it)
+        "weights_version": int(extra.get("weights_version", 0)),
         "prompt_tokens": req.prompt_len,
         "new_tokens": len(req.generated),
         "prefix_blocks": req.n_shared_blocks,

@@ -15,10 +15,12 @@ LINT_THREAD_DOMAINS = {
     "TickLoop.*": "engine",
     "Router.*": "router",
     "Writer.*": "journal",
+    "Controller.*": "lifecycle",
 }
 
 LINT_LOCKED_STATE = {
     "Counters": {"lock": "_lock", "attrs": ["ttft_s", "n_finished"]},
+    "Policy": {"lock": "_lock", "attrs": ["shed_load"]},
 }
 
 
@@ -58,6 +60,20 @@ class TickLoop:
     def tick(self):
         self.engine.scheduler.queue.append(1)  # engine domain: NOT a finding
         self._wlive.clear()  # BITE journal-writer-owned state from engine domain
+        self.controller._roll_active = True  # BITE lifecycle-owned state from engine domain
+
+
+class Controller:
+    def roll(self):
+        self._roll_active = True  # the controller's own method: NOT a finding
+        self._roll_history.append({})
+
+
+class Policy:
+    def on_tick(self):
+        self.shed_load = True  # BITE verdict state outside the policy lock
+        with self._lock:
+            self.shed_load = False  # under the lock: NOT a finding
 
 
 class Counters:

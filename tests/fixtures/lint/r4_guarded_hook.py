@@ -14,6 +14,9 @@ class Engine:
         if self.faults.trip("decode") is not None:  # BITE faults unguarded
             raise RuntimeError("boom")
 
+    def step_unguarded_actions(self):
+        self.actions.on_tick([], None)  # BITE actions hook unguarded
+
     def step_guarded(self):
         if self.tracer is not None:
             self.tracer.instant("tick")  # guarded: NOT a finding

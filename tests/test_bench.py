@@ -87,6 +87,7 @@ def test_priority_matches_config_dicts():
         + list(bench.SERVE_CHAOS_CONFIGS) + list(bench.SERVE_MIXED_CONFIGS)
         + list(bench.SERVE_SPEC_CONFIGS) + list(bench.SERVE_SHARDED_CONFIGS)
         + list(bench.SERVE_RESTART_CONFIGS)
+        + list(bench.SERVE_ROLLING_CONFIGS)
         if not n.startswith("smoke")
     }
     assert set(bench.PRIORITY) == non_smoke | bench.EXTRA_CHILDREN
@@ -106,7 +107,8 @@ def test_warm_smoke_offline():
                                  and n not in bench.SERVE_MIXED_CONFIGS
                                  and n not in bench.SERVE_SPEC_CONFIGS
                                  and n not in bench.SERVE_SHARDED_CONFIGS
-                                 and n not in bench.SERVE_RESTART_CONFIGS}
+                                 and n not in bench.SERVE_RESTART_CONFIGS
+                                 and n not in bench.SERVE_ROLLING_CONFIGS}
 
 
 def test_warm_limit_covers_top_priority_only():
@@ -270,6 +272,36 @@ def test_serve_restart_smoke_offline():
     assert res["journal_resumed_total"] >= 1
     assert res["journal_overhead_ok"] is True
     assert res["drain_left_unterminated"] == 0
+
+
+def test_serve_rolling_smoke_offline(tmp_path):
+    """The rolling-upgrade child: ONE trace over a 3-replica fleet,
+    steady vs rolling legs — zero dropped streams, token parity across
+    the full roll, zero compiles for the same-shaped swap, and the
+    degradation pair — then the slo_gate CLI consumes the capture with
+    ``--max-p99-ttft-degradation`` (pass at a generous bound, fail at
+    an impossible one: the gate must be able to bite)."""
+    res = bench._spawn("smoke_serve_rolling", 600,
+                       env={"BENCH_PLATFORM": "cpu"})
+    assert res.get("ok") is True, res
+    assert res["dropped_streams"] == 0
+    assert res["token_parity_across_roll"] is True
+    assert res["rolled"] == [0, 1, 2]
+    assert res["compiles_added_by_roll"] == 0
+    assert res["weights_versions"] == [1, 1, 1]
+    assert res["lifecycle_actions"].get("upgrade_replica") == 3
+    assert res["ttft_p99_degradation"] > 0
+    capture = tmp_path / "rolling.json"
+    capture.write_text(json.dumps(res))
+    from tools.slo_gate import main as gate_main
+
+    # CPU tick jitter makes the ratio noisy; the smoke pins the WIRING
+    # (gate reads the capture, passes a loose bound, fails a sub-1.0
+    # one — a roll can't beat steady-state p99)
+    assert gate_main([str(capture),
+                      "--max-p99-ttft-degradation", "1000"]) == 0
+    assert gate_main([str(capture),
+                      "--max-p99-ttft-degradation", "0.001"]) == 1
 
 
 def test_decomp_smoke_offline():

@@ -318,8 +318,10 @@ def test_http_resume_replays_suffix_then_live(tiny, tmp_path):
     """The Last-Event-ID protocol against a server built on a journal a
     dead process left behind: re-POST with the original request id (and
     GET /v1/completions/<id>) replays exactly the missing suffix, then
-    continues live; token ids carry SSE event ids; a second claim of a
-    FINISHED stream 404s."""
+    continues live; token ids carry SSE event ids; a RETRY of a
+    finished-and-claimed stream re-reads it from the bounded claimed
+    LRU (the PR 9 single-shot claim, made multi-read) instead of
+    404ing."""
     cfg, params = tiny
     path = str(tmp_path / "j")
     j = RequestJournal(path)
@@ -350,16 +352,18 @@ def test_http_resume_replays_suffix_then_live(tiny, tmp_path):
         loop = asyncio.get_running_loop()
         _, prom = await loop.run_in_executor(
             None, http_get, srv.host, srv.port, "/metrics")
-        # a finished-and-claimed stream is gone: second resume 404s
-        res404 = await astream_completion(
+        # a finished-and-claimed stream stays re-readable: a client
+        # whose first resume read tore on the wire retries and gets the
+        # full replay again from the claimed LRU, not a 404
+        res_retry = await astream_completion(
             srv.host, srv.port,
             {"model": "tiny", "request_id": f"cmpl-{reqs[0].req_id}",
              "last_event_id": 0, "stream": True}, timeout=30)
         srv.begin_drain()
         await srv.serve_until_shutdown()
-        return outs, prom.decode(), res404
+        return outs, prom.decode(), res_retry
 
-    outs, prom, res404 = asyncio.run(
+    outs, prom, res_retry = asyncio.run(
         asyncio.wait_for(main(), timeout=120))
     for r, res in outs:
         assert res["finish_reason"] in ("length", "stop")
@@ -368,10 +372,50 @@ def test_http_resume_replays_suffix_then_live(tiny, tmp_path):
     assert f"llm_serve_journal_replayed_total {len(reqs)}" in prom
     assert "llm_serve_journal_resumed_total 3" in prom
     assert "llm_serve_journal_fsync_p99_s" in prom
-    assert res404["status"] == 404, res404
+    assert res_retry["status"] == 200, res_retry
+    assert res_retry["token_ids"] == _offline(cfg, params, prompts[0], 8)
+    assert res_retry["finish_reason"] in ("length", "stop")
     # clean drain (all streams terminal) → empty replay set on disk
     state, _, _ = scan_journal(path)
     assert state == {}
+
+
+def test_claimed_terminal_lru_is_bounded(tiny):
+    """The multi-read claim is BOUNDED: claimed terminals live in a
+    64-entry LRU, so retries re-read indefinitely while recent but a
+    long-dead claim eventually 404s — a week-long server's memory
+    stays flat whatever clients retry."""
+    cfg, params = tiny
+    engine = _engine(cfg, params)
+    runner = HttpServer(engine, model_id="tiny").runner  # never started
+
+    async def main():
+        loop = asyncio.get_running_loop()
+        for rid in range(70):
+            runner._stash_resumable(
+                rid, {"tokens": [1, 2], "deltas": [None, None]},
+                "length", None)
+        for rid in range(70):
+            aq: asyncio.Queue = asyncio.Queue()
+            runner._exec_attach(("attach", rid, 0, loop, aq))
+        await asyncio.sleep(0)
+        assert len(runner._claimed) == 64
+        # the oldest claims were evicted...
+        aq = asyncio.Queue()
+        runner._exec_attach(("attach", 0, 0, loop, aq))
+        await asyncio.sleep(0)
+        assert (await aq.get())[0] == "gone"
+        # ...recent ones replay again and again
+        for _ in range(3):
+            aq = asyncio.Queue()
+            runner._exec_attach(("attach", 69, 0, loop, aq))
+            await asyncio.sleep(0)
+            assert (await aq.get())[0] == "accepted"
+            toks = [await aq.get() for _ in range(2)]
+            assert [t[1] for t in toks] == [1, 2]
+            assert (await aq.get())[0] == "finish"
+
+    asyncio.run(asyncio.wait_for(main(), timeout=60))
 
 
 @pytest.mark.http

@@ -507,6 +507,42 @@ def _self_check() -> None:
         rebuilt.journal = None
     print(f"compile counts OK (journaled): {rebuilt.compile_counts()}")
 
+    # rolling upgrade (serve/lifecycle + ReplicaSet.rolling_upgrade):
+    # a same-shaped weight swap must compile NOTHING — params are jit
+    # call arguments, every rolled replica adopts ONE shared step
+    # callable (share_compiled_steps), and the drain re-prefills reuse
+    # the warm shapes.  Mid-trace streams survive the roll.
+    from llm_np_cp_tpu.serve.replica import ReplicaSet
+
+    fleet = ReplicaSet([
+        ServeEngine(
+            params, cfg, sampler=Sampler(kind="greedy"), max_slots=2,
+            num_blocks=32, block_size=8, max_seq_len=64,
+            cache_dtype=jnp.float32, mixed_step="on",
+        )
+        for _ in range(3)
+    ])
+    for e in fleet.engines:
+        e.warmup([5], max_new_tokens=6)
+    for p in prompts:
+        fleet.submit(p, 6)
+    fleet.step()
+    with CompileCounter().watch() as counter:
+        fleet.rolling_upgrade(lambda: params, version=1,
+                              steps_between=1)
+        fleet.run_until_complete()
+    assert counter.count == 0, (
+        f"same-weights rolling upgrade compiled: {counter.events}"
+    )
+    shared = {id(e._mixed_step) for e in fleet.engines}
+    assert len(shared) == 1, (
+        "rolled replicas do not share one step callable — new weights "
+        "would compile per replica, not per fleet"
+    )
+    assert all(e.weights_version == 1 for e in fleet.engines)
+    print(f"compile counts OK (rolling upgrade): "
+          f"{fleet.engines[0].compile_counts()}")
+
 
 if __name__ == "__main__":
     _self_check()

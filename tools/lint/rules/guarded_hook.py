@@ -5,7 +5,9 @@ The serve stack's optional instruments — the ``tracer``
 (serve/faults.FaultInjector), the ``journal`` durable request journal
 (serve/journal.RequestJournal), the ``request_log`` canonical request
 log (serve/request_log.RequestLog), the ``sentinel`` tick anomaly
-detector and the ``slo`` goodput tracker (serve/slo.py) — are OFF by
+detector, the ``slo`` goodput tracker (serve/slo.py) and the
+``actions`` lifecycle auto-action policy (serve/lifecycle.py) — are
+OFF by
 default, spelled as ``None`` attributes.  The zero-overhead contract is that every hook call sits
 behind an ``is None`` / ``is not None`` check in the same function, so
 instruments-off costs an attribute load and a branch: no dict built for
@@ -39,7 +41,8 @@ from tools.lint.core import (
 
 RULE_ID = "R4"
 
-HOOKS = ("tracer", "faults", "journal", "request_log", "sentinel", "slo")
+HOOKS = ("tracer", "faults", "journal", "request_log", "sentinel", "slo",
+         "actions")
 # engine methods where binding self.tracer/self.metrics/self.journal to
 # a local is fine: construction, cloning, and the warmup
 # suspend/restore swap — none of them run inside a supervised tick
@@ -163,7 +166,7 @@ class _Rule:
                 if chain is None or len(chain) != 2 or chain[0] != "self":
                     continue
                 if chain[1] not in ("tracer", "metrics", "journal",
-                                    "request_log"):
+                                    "request_log", "actions"):
                     continue
                 if not any(isinstance(t, ast.Name) for t in node.targets):
                     continue

@@ -76,6 +76,13 @@ DOMAIN_TABLE: tuple[tuple[str, str, str], ...] = (
     ("serve/replica.py", "PrefixRouter.*", "router"),
     ("serve/replica.py", "ReplicaRunner.*", "loop"),
     ("serve/replica.py", "*", "engine"),
+    # fleet lifecycle (serve/lifecycle.py): the controller's roll state
+    # is lifecycle-domain-owned — only LifecycleController methods may
+    # mutate it; everything else in the module (ActionPolicy above all)
+    # runs on the engine tick thread, with the sentinel/tracker →
+    # ActionPolicy signal flow lock-grouped below
+    ("serve/lifecycle.py", "LifecycleController.*", "lifecycle"),
+    ("serve/lifecycle.py", "*", "engine"),
     ("serve/metrics.py", "*", "shared"),
     ("serve/tracing.py", "*", "shared"),
     ("serve/faults.py", "*", "shared"),
@@ -127,6 +134,15 @@ REQLOG_STATE: tuple[tuple[str, ...], ...] = (
     ("_wlines",),
 )
 
+# lifecycle-controller-owned state (serve/lifecycle.py): the in-flight
+# roll flag and history — only LifecycleController methods (the
+# lifecycle domain) drive a roll; handlers and tick code must call
+# rolling_upgrade()/autoscale_tick() instead of poking the state
+LIFECYCLE_STATE: tuple[tuple[str, ...], ...] = (
+    ("_roll_active",),
+    ("_roll_history",),
+)
+
 # (owning domain, state table, remediation hint)
 DOMAIN_OWNED: tuple[tuple[str, tuple, str], ...] = (
     ("engine", OWNED_STATE,
@@ -137,6 +153,8 @@ DOMAIN_OWNED: tuple[tuple[str, tuple, str], ...] = (
      "enqueue a record for the writer thread instead"),
     ("reqlog", REQLOG_STATE,
      "enqueue a record for the writer thread instead"),
+    ("lifecycle", LIFECYCLE_STATE,
+     "drive the roll through LifecycleController methods instead"),
 )
 
 # lock-protected groups: attrs of a class that may only be MUTATED under
@@ -155,7 +173,7 @@ LOCK_STATE: tuple[dict, ...] = (
             "kv_bytes_tick", "prefix_blocks_requested",
             "prefix_blocks_hit", "mixed_prefill_tokens",
             "mixed_decode_tokens", "t_start", "t_last",
-            "anomaly_ticks",
+            "anomaly_ticks", "lifecycle_actions",
         },
         # "caller holds the lock" helpers — annotated, not inferred
         "lock_assumed": {"_record_latencies", "_trim"},
@@ -167,6 +185,7 @@ LOCK_STATE: tuple[dict, ...] = (
         "attrs": {
             "_inflight", "_handback", "_recent_deaths", "_death_t",
             "_backoff_delay", "recovering", "_gen",
+            "_pending_weights",
         },
         "lock_assumed": {"_exec_inner", "_terminal_crash"},
     },
@@ -197,6 +216,19 @@ LOCK_STATE: tuple[dict, ...] = (
         "attrs": {"_pending", "_stopping", "n_records",
                   "n_write_errors"},
         "lock_assumed": set(),
+    },
+    {
+        # the sentinel/tracker → ActionPolicy signal flow: the engine
+        # tick thread writes the verdict state + counters, the HTTP
+        # loop reads them for the 503 shedding check and the scrape —
+        # every mutation takes the policy's lock
+        "file": "serve/lifecycle.py",
+        "class": "ActionPolicy",
+        "lock": "_lock",
+        "attrs": {"shed_prefill", "shed_load", "retry_after_s",
+                  "last_burn", "actions_total", "_anom_streak",
+                  "_clean_ticks", "_last_flip"},
+        "lock_assumed": {"_can_flip"},
     },
 )
 

@@ -18,6 +18,11 @@ Checks (each only when its flag/keys are present):
 - ``--min-attainment F``        — slo_attainment >= F
 - ``--min-goodput F``           — goodput_tok_s >= F
 - ``--max-burn F``              — every slo_burn_rate_* <= F
+- ``--max-p99-ttft-degradation R`` — rolling-upgrade mode, consuming
+  the ``serve_rolling_upgrade`` bench leg: the roll must drop ZERO
+  streams and its p99 TTFT must stay within R× the steady leg's
+  (``ttft_p99_degradation`` recorded by the bench, or recomputed from
+  ``legs.{steady,rolling}.ttft_s_p99``).
 - ``--baseline OLD.json``       — compare against an older capture:
   ``--max-attainment-drop D`` (absolute) and ``--max-goodput-drop R``
   (fractional, 0.1 = 10%).
@@ -98,6 +103,55 @@ def _fail(msgs: list[str], text: str) -> None:
     msgs.append(text)
 
 
+def _gate_rolling(rec: dict, nums: dict[str, float], max_deg: float,
+                  failures: list[str]) -> int | None:
+    """The rolling-upgrade gate: zero dropped streams and bounded p99
+    TTFT degradation during the roll.  Returns an exit code to
+    short-circuit with (2 = the record carries no rolling data), or
+    None to continue with any other checks."""
+
+    def _num(v: Any) -> float | None:
+        if isinstance(v, (int, float)) and not isinstance(v, bool) \
+                and not math.isnan(v):
+            return float(v)
+        return None
+
+    deg = _num(rec.get("ttft_p99_degradation"))
+    if deg is None:
+        legs = rec.get("legs")
+        if isinstance(legs, dict):
+            steady = _num(legs.get("steady", {}).get("ttft_s_p99"))
+            rolling = _num(legs.get("rolling", {}).get("ttft_s_p99"))
+            if steady == 0.0:
+                # a zero steady baseline is a broken capture, not a
+                # missing one — say so instead of 'no rolling data'
+                print("slo-gate: steady ttft_s_p99 is 0.0 — cannot "
+                      "compute a degradation ratio from this capture",
+                      file=sys.stderr)
+                return 2
+            if steady is not None and rolling is not None:
+                deg = rolling / steady
+    if deg is not None:
+        nums["ttft_p99_degradation"] = deg
+    if deg is None:
+        print("slo-gate: no ttft_p99_degradation (or "
+              "legs.{steady,rolling}.ttft_s_p99) in the record — was "
+              "this a serve_rolling_upgrade capture?", file=sys.stderr)
+        return 2
+    if deg > max_deg:
+        _fail(failures,
+              f"ttft_p99_degradation {deg:.3f} > max {max_deg} "
+              "(p99 TTFT during the roll vs steady)")
+    dropped = rec.get("dropped_streams")
+    if dropped is not None:
+        nums["dropped_streams"] = float(dropped)
+        if dropped:
+            _fail(failures,
+                  f"rolling upgrade dropped {dropped} stream(s); the "
+                  "roll must drop zero")
+    return None
+
+
 def run_gate(args: argparse.Namespace) -> int:
     try:
         data = json.load(open(args.bench))
@@ -110,13 +164,18 @@ def run_gate(args: argparse.Namespace) -> int:
               f"{args.bench}", file=sys.stderr)
         return 2
     nums = slo_numbers(rec)
-    if not nums:
+    if not nums and args.max_p99_ttft_degradation is None:
         print(f"slo-gate: {args.bench} carries no SLO numbers "
               "(slo_attainment / goodput_tok_s) — was the bench run "
               "with an SLO policy?", file=sys.stderr)
         return 2
 
     failures: list[str] = []
+    if args.max_p99_ttft_degradation is not None:
+        rc = _gate_rolling(rec, nums, args.max_p99_ttft_degradation,
+                           failures)
+        if rc is not None:
+            return rc
     attain = nums.get("slo_attainment")
     goodput = nums.get("goodput_tok_s")
     if args.min_attainment is not None:
@@ -187,6 +246,12 @@ def main(argv: list[str] | None = None) -> int:
                    help="minimum goodput_tok_s")
     p.add_argument("--max-burn", type=float, default=None,
                    help="maximum error-budget burn rate, any window")
+    p.add_argument("--max-p99-ttft-degradation", type=float, default=None,
+                   metavar="R",
+                   help="rolling-upgrade mode: the roll leg's p99 TTFT "
+                   "must stay within R x the steady leg's, and the "
+                   "roll must have dropped zero streams (consumes the "
+                   "serve_rolling_upgrade bench record)")
     p.add_argument("--baseline", default=None,
                    help="older bench JSON to compare against")
     p.add_argument("--max-attainment-drop", type=float, default=0.05,
