@@ -995,6 +995,12 @@ def run_serve_mixed_config(name: str) -> dict:
         prefill_chunk=chunk,
     )
     ragged_err = kernel_error(ragged_kernel_name(False))
+    from llm_np_cp_tpu.serve.telemetry import TelemetryModel
+
+    # one shared roofline model for both legs (immutable, config+params
+    # derived): the legs record achieved GB/s / utilization / MFU so
+    # tools/slo_gate.py --min-bandwidth-util can gate live captures
+    telemetry = TelemetryModel(config, params)
 
     # long-prefill-heavy: prompts in the TOP half of the length range,
     # decode budgets mixed chat (short) + completion (long) — the shape
@@ -1023,6 +1029,7 @@ def run_serve_mixed_config(name: str) -> dict:
             prefill_chunk=chunk,
             cache_dtype=jnp.bfloat16,
             mixed_step=mode,
+            telemetry=telemetry,
         )
         engine.warmup([int(t["prompt"].size) for t in trace],
                       max_new_tokens=spec["max_tokens"])
@@ -1049,6 +1056,15 @@ def run_serve_mixed_config(name: str) -> dict:
             "preemptions": snap["preemptions"],
             "mixed_prefill_tokens": snap["mixed_prefill_tokens"],
             "mixed_decode_tokens": snap["mixed_decode_tokens"],
+            # roofline telemetry (CPU: the absolute GB/s is meaningless
+            # — no HBM — but the fields prove the plumbing and give
+            # slo_gate --min-bandwidth-util its input on live captures)
+            "roofline_gbps_mean": round(
+                snap.get("roofline_gbps_mean", 0.0), 4),
+            "roofline_util_mean": round(
+                snap.get("roofline_util_mean", 0.0), 8),
+            "mfu_mean": round(snap.get("mfu_mean", 0.0), 8),
+            "hbm_gbps": snap.get("hbm_gbps"),
             "compile_counts": engine.compile_counts(),
         }
         if mode == "on":
@@ -1077,6 +1093,11 @@ def run_serve_mixed_config(name: str) -> dict:
         "dispatches_per_tick": m["dispatches_per_tick"],
         "dispatches_per_tick_split": s["dispatches_per_tick"],
         "dispatch_win": m["dispatches"] < s["dispatches"],
+        # headline roofline mirror (the unified leg's — what
+        # slo_gate --min-bandwidth-util consumes)
+        "roofline_gbps_mean": m["roofline_gbps_mean"],
+        "roofline_util_mean": m["roofline_util_mean"],
+        "hbm_gbps": m["hbm_gbps"],
         "legs": per_leg,
         "ragged_kernel_probe": ragged_err or "ok",
     }
@@ -1119,6 +1140,9 @@ def run_serve_spec_config(name: str) -> dict:
         prefill_chunk=chunk,
     )
     ragged_err = kernel_error(ragged_kernel_name(False))
+    from llm_np_cp_tpu.serve.telemetry import TelemetryModel
+
+    telemetry = TelemetryModel(config, params)
 
     rng = np.random.default_rng(23)
     trace = poisson_trace(
@@ -1152,6 +1176,7 @@ def run_serve_spec_config(name: str) -> dict:
             cache_dtype=jnp.bfloat16,
             mixed_step="on",
             spec_k=k,
+            telemetry=telemetry,
         )
         engine.warmup([int(t["prompt"].size) for t in trace],
                       max_new_tokens=spec["max_tokens"])
@@ -1186,6 +1211,15 @@ def run_serve_spec_config(name: str) -> dict:
             "goodput_tok_s": round(snap.get("goodput_tok_s", 0.0), 1),
             "slo_attainment": snap.get("slo_attainment"),
             "slo_burn_rate_5m": snap.get("slo_burn_rate_5m", 0.0),
+            # roofline telemetry: on the spec leg the verify lanes ride
+            # the same HBM sweep, so utilization per emitted token is
+            # the whole speculative win made visible
+            "roofline_gbps_mean": round(
+                snap.get("roofline_gbps_mean", 0.0), 4),
+            "roofline_util_mean": round(
+                snap.get("roofline_util_mean", 0.0), 8),
+            "mfu_mean": round(snap.get("mfu_mean", 0.0), 8),
+            "hbm_gbps": snap.get("hbm_gbps"),
             "compile_counts": engine.compile_counts(),
         }
         if k:
@@ -1224,6 +1258,11 @@ def run_serve_spec_config(name: str) -> dict:
         "decode_tok_s_p50_plain": p["decode_tok_s_p50"],
         "dispatches_per_tick": s["dispatches_per_tick"],
         "ticks_spec_vs_plain": [s["ticks"], p["ticks"]],
+        # headline roofline mirror (the spec leg's — what
+        # slo_gate --min-bandwidth-util consumes)
+        "roofline_gbps_mean": s["roofline_gbps_mean"],
+        "roofline_util_mean": s["roofline_util_mean"],
+        "hbm_gbps": s["hbm_gbps"],
         "legs": per_leg,
         "ragged_kernel_probe": ragged_err or "ok",
     }

@@ -284,6 +284,29 @@ def _add_serve_engine_flags(p: argparse.ArgumentParser,
                    "when the 5m SLO burn rate exceeds B (release at "
                    "B/2; needs --slo-ttft/--slo-tpot for burn to be "
                    "measured)")
+    p.add_argument("--roofline", action=argparse.BooleanOptionalAction,
+                   default=False,
+                   help="device roofline telemetry "
+                   "(serve/telemetry.py): an analytic per-tick "
+                   "byte/FLOP model combined with the measured "
+                   "dispatch wall yields achieved GB/s, utilization "
+                   "vs --hbm-gbps and an MFU estimate — per-tick "
+                   "gauges/histograms on /metrics, tick args in the "
+                   "trace plane, a roofline_deficit sentinel signal, "
+                   "and per-request cost attribution in the request "
+                   "log.  Default: off (hooks are zero-overhead "
+                   "no-ops)")
+    p.add_argument("--hbm-gbps", type=float, default=819.0, metavar="G",
+                   help="the HBM roofline --roofline grades "
+                   "utilization against, GB/s (819 = the ROADMAP's "
+                   "reference chip)")
+    p.add_argument("--otlp-endpoint", default=None, metavar="URL",
+                   help="ship the trace plane's spans to an "
+                   "OTLP/HTTP JSON collector (e.g. "
+                   "http://collector:4318/v1/traces), batched off the "
+                   "serving threads, drop-and-count on collector "
+                   "failure (serve/otel.py).  Implies host tracing.  "
+                   "Default: no export")
     p.add_argument("--jax-profile", default=None, metavar="DIR",
                    help="capture a jax.profiler device trace into DIR "
                    "for the run; the serve dispatch phases are wrapped "
@@ -443,6 +466,10 @@ def _validate_pool_flags(args) -> None:
             f"--shed-burn-threshold must be > 0, got "
             f"{args.shed_burn_threshold}"
         )
+    if getattr(args, "hbm_gbps", 819.0) <= 0:
+        raise SystemExit(
+            f"--hbm-gbps must be > 0, got {args.hbm_gbps}"
+        )
 
 
 def _resolve_serve_mesh(args, prog: str):
@@ -575,24 +602,38 @@ def _build_serve_engine(args, params, config, *, prog: str,
     tracer = shared_tracer
     jax_profile = getattr(args, "jax_profile", None)
     sentinel_on = getattr(args, "tick_sentinel", False)
+    otlp_endpoint = getattr(args, "otlp_endpoint", None)
     if tracer is None and (args.trace_out or args.trace_ring
-                           or jax_profile or sentinel_on):
+                           or jax_profile or sentinel_on
+                           or otlp_endpoint):
         from llm_np_cp_tpu.serve.tracing import TraceRecorder
 
         ring = args.trace_ring or None
         if ring is None and not args.trace_out:
-            # --jax-profile / --tick-sentinel alone: the recorder
-            # exists for its annotation scopes / phase timestamps —
-            # keep its memory bounded
+            # --jax-profile / --tick-sentinel / --otlp-endpoint alone:
+            # the recorder exists for its annotation scopes / phase
+            # timestamps / span feed — keep its memory bounded
             ring = 100_000
         tracer = TraceRecorder(ring=ring)
-        implied = (jax_profile or sentinel_on) \
+        implied = (jax_profile or sentinel_on or otlp_endpoint) \
             and not (args.trace_out or args.trace_ring)
         print(f"[{prog}] tracing ACTIVE (ring={ring or 'unbounded'}"
               + (f", dump to {args.trace_out}" if args.trace_out else "")
-              + (", implied by --jax-profile/--tick-sentinel"
-                 if implied else "")
+              + (", implied by --jax-profile/--tick-sentinel/"
+                 "--otlp-endpoint" if implied else "")
               + ")")
+    if otlp_endpoint and tracer is not None and tracer.otel is None:
+        # one exporter per PROCESS, shared by every replica through the
+        # shared recorder (replica engines arrive with shared_tracer
+        # already carrying it)
+        from llm_np_cp_tpu.serve.otel import OtlpExporter
+
+        OtlpExporter(
+            otlp_endpoint, resource_attrs={"llm.model": args.model},
+        ).attach(tracer)
+        print(f"[{prog}] OTLP export ACTIVE: {otlp_endpoint} "
+              "(spans batched off-thread, dropped+counted on "
+              "collector failure)")
     sentinel = None
     if sentinel_on:
         from llm_np_cp_tpu.serve.slo import TickSentinel
@@ -622,6 +663,18 @@ def _build_serve_engine(args, params, config, *, prog: str,
                   f"{actions.burn_threshold:g}"
                   + ("" if slo_on else
                      " (needs --slo-ttft/--slo-tpot to measure burn)"))
+    telemetry = None
+    if getattr(args, "roofline", False):
+        from llm_np_cp_tpu.serve.telemetry import TelemetryModel
+
+        telemetry = TelemetryModel(
+            config, params, hbm_gbps=getattr(args, "hbm_gbps", 819.0),
+        )
+        if not quiet:
+            print(f"[{prog}] roofline telemetry ACTIVE: grading "
+                  f"dispatches against {telemetry.hbm_gbps:g} GB/s "
+                  "(achieved GB/s + MFU on /metrics, per-request cost "
+                  "attribution in the request log)")
     request_log = shared_request_log
     rl_path = getattr(args, "request_log", None)
     if request_log is None and rl_path:
@@ -662,6 +715,7 @@ def _build_serve_engine(args, params, config, *, prog: str,
         request_log=request_log,
         sentinel=sentinel,
         actions=actions,
+        telemetry=telemetry,
         spec_k=(
             getattr(args, "spec_k", 4)
             if getattr(args, "speculative_serve", False) else 0
@@ -715,6 +769,21 @@ def _jax_profile_ctx(args):
     from llm_np_cp_tpu.utils.profiling import trace as jax_trace
 
     return jax_trace(args.jax_profile)
+
+
+def _close_otel(tracer, prog: str) -> None:
+    """Final flush of the OTLP exporter (if one rode the recorder):
+    everything offered is attempted against the collector once before
+    exit, then the ship/drop tally is printed."""
+    otel = getattr(tracer, "otel", None)
+    if otel is None:
+        return
+    otel.flush(10.0)
+    otel.close()
+    st = otel.stats()
+    print(f"[{prog}] OTLP export: {st['spans']} spans shipped in "
+          f"{st['batches']} batches, {st['dropped']} dropped "
+          f"({st['export_errors']} collector errors)")
 
 
 def _dump_trace(tracer, args, prog: str) -> None:
@@ -792,6 +861,7 @@ def _run_serve_bench(argv: list[str], default_model: str) -> str:
             trace, realtime=args.realtime
         )
     _dump_trace(engine.tracer, args, "serve-bench")
+    _close_otel(engine.tracer, "serve-bench")
     tick = (
         f"mixed:{engine.ragged_attn_impl}"
         f"(budget={engine.tick_token_budget})"
@@ -986,6 +1056,7 @@ def _run_http_serve(argv: list[str], default_model: str) -> str:
             upgrade_loader=upgrade_loader,
         )
     _dump_trace(tracer, args, "serve")
+    _close_otel(tracer, "serve")
     if engine.request_log is not None:
         engine.request_log.close()
     print("[serve] drained, bye")

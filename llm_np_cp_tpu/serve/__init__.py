@@ -54,6 +54,17 @@ Modules:
   ``SLOTracker``: attainment, goodput_tok_s, multi-window error-budget
   burn rates) and the ``TickSentinel`` per-phase anomaly detector;
   zero-overhead is-None hooks when off.
+- ``telemetry``   — device roofline telemetry (``TelemetryModel``): an
+  analytic per-tick byte/FLOP model (weights streamed per dispatch, KV
+  read/written from the planned tick composition, int8-aware) combined
+  with the measured dispatch wall → achieved GB/s, utilization vs the
+  HBM roofline, an MFU estimate, and per-request cost attribution
+  (exact KV bytes + token-share of weights/device time, conserving);
+  zero-overhead is-None hooks when off.
+- ``otel``        — stdlib OTLP/HTTP JSON span export
+  (``OtlpExporter``): converts ``TraceRecorder`` events to OTLP
+  ResourceSpans and ships them off-thread to a collector, batched,
+  drop-and-count on failure.
 - ``request_log`` — the canonical request log (``RequestLog``): one
   wide-event JSON line per terminal request (trace id, route, prefix
   reuse, survival lineage, per-phase latencies, SLO verdict), written
@@ -92,6 +103,7 @@ from llm_np_cp_tpu.serve.lifecycle import (
     UpgradeAborted,
 )
 from llm_np_cp_tpu.serve.metrics import ServeMetrics
+from llm_np_cp_tpu.serve.otel import OtlpExporter
 from llm_np_cp_tpu.serve.prefix_cache import PrefixCache, prefix_block_keys
 from llm_np_cp_tpu.serve.request_log import RequestLog, read_request_log
 from llm_np_cp_tpu.serve.slo import (
@@ -112,6 +124,7 @@ from llm_np_cp_tpu.serve.scheduler import (
     Scheduler,
 )
 from llm_np_cp_tpu.serve.spec import DraftState
+from llm_np_cp_tpu.serve.telemetry import TelemetryModel
 from llm_np_cp_tpu.serve.trace import poisson_trace
 from llm_np_cp_tpu.serve.tracing import TraceRecorder
 
@@ -125,6 +138,7 @@ __all__ = [
     "FaultInjected",
     "FaultInjector",
     "FreeList",
+    "OtlpExporter",
     "PrefixCache",
     "PrefixRouter",
     "QueueFull",
@@ -139,6 +153,7 @@ __all__ = [
     "Scheduler",
     "ServeEngine",
     "ServeMetrics",
+    "TelemetryModel",
     "TickSentinel",
     "TraceRecorder",
     "aggregate_slo",

@@ -127,6 +127,10 @@ from llm_np_cp_tpu.serve.scheduler import (
     RequestState,
     Scheduler,
 )
+from llm_np_cp_tpu.serve.telemetry import (
+    mixed_tick_kv_read,
+    split_tick_kv_read,
+)
 from llm_np_cp_tpu.serve.tracing import TraceRecorder, gen_trace_id
 
 Params = dict[str, Any]
@@ -139,6 +143,21 @@ _NULL_CTX = contextlib.nullcontext()
 
 def _ceil_to(n: int, g: int) -> int:
     return -(-n // g) * g
+
+
+def _roofline_targs(tel: dict) -> dict:
+    """The roofline slice of a tick's trace args (callers hold the
+    tracer guard): what tools/summarize_trace.py's roofline section and
+    a Perfetto tick click read."""
+    return {
+        "roofline_gbps": round(tel["achieved_gbps"], 3),
+        "roofline_util": round(tel["roofline_util"], 6),
+        "mfu": round(tel["mfu"], 6),
+        "device_time_s": round(tel["device_time_s"], 6),
+        "kv_read_bytes": int(tel["kv_read_bytes"]),
+        "kv_write_bytes": int(tel["kv_write_bytes"]),
+        "weight_bytes": int(tel["weight_bytes"]),
+    }
 
 
 def worst_case_slots(prompt_len: int, max_new_tokens: int, chunk: int) -> int:
@@ -217,6 +236,7 @@ class ServeEngine:
         request_log: Any = None,
         sentinel: Any = None,
         actions: Any = None,
+        telemetry: Any = None,
         weights_version: int = 0,
         spec_k: int = 0,
         spec_ngram: int = 3,
@@ -422,6 +442,14 @@ class ServeEngine:
         # budget and its shed-load verdict flips HTTP admission to
         # 503-first.  Same is-None zero-overhead discipline
         self.actions = actions
+        # device roofline telemetry (serve/telemetry.TelemetryModel):
+        # an analytic per-tick byte/FLOP bill combined with the
+        # measured dispatch→host-sync wall → achieved GB/s vs the HBM
+        # roofline, an MFU estimate, and per-request cost attribution.
+        # Host-side arithmetic only — attaching it adds zero dispatches
+        # and zero recompiles (compile-counter telemetry section).
+        # Same is-None zero-overhead discipline as faults/tracer
+        self.telemetry = telemetry
         # which checkpoint these params came from: stamped onto every
         # request at admission (journal/request-log carry it), bumped
         # by a rolling upgrade's clone_fresh(params=..., ...)
@@ -1502,6 +1530,7 @@ class ServeEngine:
             request_log=self.request_log,
             sentinel=self.sentinel,
             actions=self.actions,
+            telemetry=self.telemetry,
             weights_version=(
                 weights_version if weights_version is not None
                 else self.weights_version
@@ -1767,6 +1796,7 @@ class ServeEngine:
         are never written."""
         if self.faults is not None and self.faults.trip("prefill") is not None:
             raise FaultInjected("prefill")
+        t_tel = self.clock() if self.telemetry is not None else 0.0
         content = req.effective_prompt()
         w = self._prefill_width(req)
         req.pad = w - content.size
@@ -1842,7 +1872,19 @@ class ServeEngine:
         # lint: disable=R2 -- the phase-split design emits the first
         # token inside the prefill phase (its wall time is accounted to
         # prefill_s); the unified tick retired this extra sync
-        self._emit(req, int(np.asarray(tok)[0]))
+        tok_host = int(np.asarray(tok)[0])
+        if self.telemetry is not None:
+            # the chunk dispatches are per-request by construction: the
+            # whole bill (weights streamed per chunk, fresh K/V written,
+            # measured wall — the sync above closed the window) lands on
+            # this request, and the totals-only record keeps the metrics
+            # ledger conserving.  MUST run before _emit: a token
+            # callback may abort(), which zeroes the shared-block state
+            # the bill reads and writes the request-log line
+            self.metrics.on_telemetry(self.telemetry.prefill_cost(
+                self, req, self.clock() - t_tel
+            ))
+        self._emit(req, tok_host)
 
     # ------------------------------------------------------------------
     def step(self) -> bool:
@@ -1909,6 +1951,9 @@ class ServeEngine:
             r for r in self.scheduler.running if r.generated
         ]
         t4 = t5 = t3
+        tel = None
+        cost = None
+        tdev0 = 0.0
         if running:
             b = self.scheduler.max_slots
             mb = self.max_blocks_per_seq
@@ -1925,6 +1970,11 @@ class ServeEngine:
                 pads[r.slot] = r.pad
                 toks[r.slot] = r.generated[-1]
                 seeds[r.slot] = np.uint32(r.seed)
+            if self.telemetry is not None:
+                # analytic byte bill for this dispatch; the measured
+                # wall closes over it after the host sync below
+                cost = self.telemetry.split_tick_cost(self, running)
+                tdev0 = self.clock()
             with (jax.profiler.TraceAnnotation("serve.decode_dispatch")
                   if self.tracer is not None else _NULL_CTX):
                 nxt, self.pool.pages = self._dispatch_decode(
@@ -1942,6 +1992,13 @@ class ServeEngine:
                     time.sleep(hang)
             nxt_host = np.asarray(nxt)
             t5 = self.tracer.now_us() if self.tracer is not None else -1.0
+            if cost is not None and self.telemetry is not None:
+                # attribution lands BEFORE the deliver loop so a
+                # finishing request's canonical log line carries its
+                # final tick's cost
+                tel = self.telemetry.finish(cost, self.clock() - tdev0)
+                self.telemetry.attribute(cost, tel["device_time_s"])
+                self.metrics.on_telemetry(tel)
             for r in running:
                 self._emit(r, int(nxt_host[r.slot]))
                 self._maybe_finish(r)
@@ -1961,23 +2018,32 @@ class ServeEngine:
         outliers: list[dict] = []
         if self.tracer is not None and t0 >= 0.0:
             t6 = self.tracer.now_us()
+            targs: dict[str, Any] = {
+                "active_slots": len(running) if running else 0,
+                "queue_depth": self.scheduler.queue_depth,
+                "admitted": len(admitted),
+            }
+            if tel is not None:
+                targs.update(_roofline_targs(tel))
             self.tracer.tick(t0, (
                 ("admission", t0, t1), ("prefill", t1, t2),
                 ("grow", t2, t3), ("decode_dispatch", t3, t4),
                 ("host_sync", t4, t5), ("deliver", t5, t6),
-            ), args={
-                "active_slots": len(running) if running else 0,
-                "queue_depth": self.scheduler.queue_depth,
-                "admitted": len(admitted),
-            })
+            ), args=targs)
             if self.sentinel is not None:
                 # same literal phase tuple the tracer records (R2
                 # recovers its exempt spans from the tick() literal, so
-                # the tuple cannot be hoisted into a shared local)
+                # the tuple cannot be hoisted into a shared local); the
+                # roofline deficit rides along as a pseudo-phase so a
+                # persistent utilization regression pages like a
+                # host_sync one
                 outliers = self._sentinel_observe((
                     ("admission", t0, t1), ("prefill", t1, t2),
                     ("grow", t2, t3), ("decode_dispatch", t3, t4),
                     ("host_sync", t4, t5), ("deliver", t5, t6),
+                ) + (
+                    (("roofline_deficit", 0.0, tel["deficit_us"]),)
+                    if tel is not None else ()
                 ))
         self._actions_tick(outliers)
         return self.scheduler.has_work
@@ -2228,7 +2294,16 @@ class ServeEngine:
         # drafts actually packed (post-trim) / accepted by the verifier
         n_spec_tok = sum(r.draft_len for r in decode_rows)
         n_spec_acc = 0
+        tel = None
+        cost = None
         if decode_rows or prefill_segs:
+            if self.telemetry is not None:
+                # the analytic byte/FLOP bill MUST run before the
+                # accept walk below — verify lanes live in draft_len
+                # only until then
+                cost = self.telemetry.mixed_tick_cost(
+                    self, decode_rows, prefill_segs
+                )
             args = self._pack_mixed(decode_rows, prefill_segs)
             td0 = self.clock()
             with (jax.profiler.TraceAnnotation("serve.mixed_dispatch")
@@ -2245,6 +2320,13 @@ class ServeEngine:
                     time.sleep(hang)
             nxt_host = np.asarray(nxt)
             t5 = self.tracer.now_us() if self.tracer is not None else -1.0
+            if cost is not None and self.telemetry is not None:
+                # attribution lands BEFORE the deliver walks so a
+                # finishing request's canonical log line carries its
+                # final tick's cost
+                tel = self.telemetry.finish(cost, self.clock() - td0)
+                self.telemetry.attribute(cost, tel["device_time_s"])
+                self.metrics.on_telemetry(tel)
             if n_prefill_tok:
                 # per-request prefill time: the dispatch+sync wall split
                 # by token share (the mixed analogue of Request.prefill_s)
@@ -2330,6 +2412,8 @@ class ServeEngine:
                 # dispatch and how many paid off
                 targs["spec_draft_tokens"] = n_spec_tok
                 targs["spec_accept_tokens"] = n_spec_acc
+            if tel is not None:
+                targs.update(_roofline_targs(tel))
             self.tracer.tick(t0, (
                 ("admission", t0, t1), ("draft", t1, td),
                 ("grow", td, t2), ("plan", t2, t3),
@@ -2338,12 +2422,18 @@ class ServeEngine:
             ), args=targs)
             if self.sentinel is not None:
                 # same literal tuple as the tick() call above (R2's
-                # exempt-span recovery reads the literal there)
+                # exempt-span recovery reads the literal there); the
+                # roofline deficit rides along as a pseudo-phase so a
+                # persistent utilization regression pages like a
+                # host_sync one
                 outliers = self._sentinel_observe((
                     ("admission", t0, t1), ("draft", t1, td),
                     ("grow", td, t2), ("plan", t2, t3),
                     ("mixed_dispatch", t3, t4),
                     ("host_sync", t4, t5), ("deliver", t5, t6),
+                ) + (
+                    (("roofline_deficit", 0.0, tel["deficit_us"]),)
+                    if tel is not None else ()
                 ))
         self._actions_tick(outliers)
         return self.scheduler.has_work
@@ -2408,48 +2498,13 @@ class ServeEngine:
         """K/V bytes this mixed tick's attention touches.  The ragged
         kernel streams each q tile's visible blocks (window-aware per
         layer); the XLA fallback materializes every token's full padded
-        row view, counted as such."""
-        cfg = self.config
-        item = self.cache_dtype.itemsize
-        per_slot = cfg.num_key_value_heads * cfg.head_dim * item * 2
-        if self.cache_dtype == jnp.int8:
-            per_slot += cfg.num_key_value_heads * 4 * 2
-        n_layers = cfg.num_hidden_layers
-        qb = self._q_tile
-        if self.ragged_attn_impl != "pallas":
-            toks = len(decode_rows) + sum(
-                -(-n // qb) * qb for _, n in prefill_segs
-            )
-            return toks * self.max_seq_len * n_layers * per_slot
-        win = cfg.sliding_window
-        n_sliding = (
-            sum(cfg.layer_is_sliding(i) for i in range(n_layers))
-            if win is not None else 0
-        )
-        bs = self.block_size
-
-        def tile_slots(pad: int, qpos0: int, qlast: int) -> tuple[int, int]:
-            full = (qlast // bs - pad // bs + 1) * bs
-            if not n_sliding:
-                return full, 0
-            lo = max(pad, qpos0 - win + 1)
-            return full, (qlast // bs - lo // bs + 1) * bs
-
-        slot_layers = 0
-        for r in decode_rows:
-            s = r.cache_len - 1
-            g_full, g_win = tile_slots(r.pad, s, s)
-            slot_layers += (n_layers - n_sliding) * g_full + n_sliding * g_win
-        for r, n in prefill_segs:
-            start = r.pad + r.prefill_done
-            for k in range(-(-n // qb)):
-                q0 = start + k * qb
-                ql = min(qb, n - k * qb)
-                g_full, g_win = tile_slots(r.pad, q0, q0 + ql - 1)
-                slot_layers += (
-                    (n_layers - n_sliding) * g_full + n_sliding * g_win
-                )
-        return slot_layers * per_slot
+        row view, counted as such.  The math lives in serve/telemetry
+        (which also yields the per-request split for cost attribution)
+        so the metrics gauge and the roofline model can never drift;
+        called post-accept-walk, draft_len is 0 and the numbers match
+        the historical draft-free accounting exactly."""
+        return int(mixed_tick_kv_read(self, decode_rows, prefill_segs,
+                                      per_request=False)[0])
 
     def _warm_mixed_bucket(self, t_w: int) -> None:
         """Compile one packed-width bucket with an all-dead batch: every
@@ -2533,31 +2588,10 @@ class ServeEngine:
         materialize the full padded [L, B, S_max] view regardless of
         content; the paged kernel streams only each row's visible blocks
         (first-pad block through the length block — and on sliding-
-        window layers only the window's blocks, counted per layer)."""
-        cfg = self.config
-        item = self.cache_dtype.itemsize
-        per_slot = cfg.num_key_value_heads * cfg.head_dim * item * 2  # K+V
-        if self.cache_dtype == jnp.int8:
-            per_slot += cfg.num_key_value_heads * 4 * 2  # f32 scale pages
-        n_layers = cfg.num_hidden_layers
-        if self.decode_attn_impl != "paged":
-            return self.scheduler.max_slots * self.max_seq_len \
-                * n_layers * per_slot
-        bs = self.block_size
-        win = cfg.sliding_window
-        n_sliding = (
-            sum(cfg.layer_is_sliding(i) for i in range(n_layers))
-            if win is not None else 0
-        )
-        slot_layers = 0  # sum over rows of (slots streamed × layers)
-        for r in running:
-            nb_hi = -(-r.cache_len // bs)
-            full = (nb_hi - r.pad // bs) * bs
-            slot_layers += (n_layers - n_sliding) * full
-            if n_sliding:
-                pad_eff = max(r.pad, r.cache_len - win)
-                slot_layers += n_sliding * (nb_hi - pad_eff // bs) * bs
-        return slot_layers * per_slot
+        window layers only the window's blocks, counted per layer).
+        The math lives in serve/telemetry (shared with the roofline
+        model's per-request attribution) so the two cannot drift."""
+        return int(split_tick_kv_read(self, running, per_request=False)[0])
 
     def warmup(
         self, prompt_lens: list[int], max_new_tokens: int = 2,
@@ -2590,6 +2624,9 @@ class ServeEngine:
         tracer, self.tracer = self.tracer, None
         journal, self.journal = self.journal, None
         request_log, self.request_log = self.request_log, None
+        # telemetry too: warmup ticks are compile-only, not device work
+        # worth billing or baselining
+        telemetry, self.telemetry = self.telemetry, None
         # the SLO tracker is suspended the same way (the dummy request
         # must not count as a verdict) and survives _warmup_body's
         # metrics reset — the fresh ServeMetrics gets it back
@@ -2602,6 +2639,7 @@ class ServeEngine:
             self.tracer = tracer
             self.journal = journal
             self.request_log = request_log
+            self.telemetry = telemetry
             self.metrics.slo = slo_tracker
 
     def _warmup_body(self, prompt_lens: list[int],

@@ -1376,6 +1376,17 @@ class HttpServer:
             "journal_resumed_total": float(
                 getattr(self.runner, "journal_resumed", 0)),
         }
+        # OTLP span export (serve/otel.py): shipped/dropped counters so
+        # a silent collector outage is visible on the scrape
+        otel = getattr(self.tracer, "otel", None)
+        if otel is not None:
+            ostats = otel.stats()
+            journal_gauges.update({
+                "otlp_spans_exported_total": float(ostats["spans"]),
+                "otlp_spans_dropped_total": float(ostats["dropped"]),
+                "otlp_export_errors_total": float(
+                    ostats["export_errors"]),
+            })
         journal = getattr(self.runner, "journal", None)
         if journal is not None:
             jstats = journal.stats()

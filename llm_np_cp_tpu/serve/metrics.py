@@ -24,6 +24,13 @@ flat dict (``snapshot()``) so the CLI, bench.py, tests, and the HTTP
                              gather path streams the full padded view,
                              the paged kernel only each row's visible
                              blocks)
+- ``roofline_*`` / ``*_bytes_total`` / ``device_time_s_total`` — device
+                             roofline telemetry (serve/telemetry.py):
+                             achieved GB/s, utilization vs --hbm-gbps
+                             and MFU per graded dispatch, plus the
+                             exact byte/time ledgers per-request cost
+                             attribution sums back to (present only
+                             when a TelemetryModel is attached)
 - ``queue_wait_s_*`` / ``prefill_s_*`` — per-request phase splits
                              (submit → first admission; cumulative
                              prefill dispatch time incl. re-prefills),
@@ -73,6 +80,12 @@ DECODE_TOK_S_BUCKETS = (0.5, 1.0, 2.0, 5.0, 10.0, 20.0, 50.0, 100.0,
 # 0..spec_k): integer upper bounds; the tail bucket absorbs any larger
 # spec_k an operator configures
 SPEC_ACCEPT_BUCKETS = (0.0, 1.0, 2.0, 3.0, 4.0, 6.0, 8.0, 12.0, 16.0)
+# Roofline utilization per tick (achieved GB/s over --hbm-gbps, from
+# serve/telemetry.py): log-ish lower buckets because CPU test runs sit
+# far below the roofline while a healthy TPU tick should land in the
+# top few buckets
+ROOFLINE_UTIL_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+                         0.2, 0.35, 0.5, 0.65, 0.8, 0.9, 1.0)
 
 
 def _pcts(values: list[float], name: str) -> dict[str, float]:
@@ -155,6 +168,22 @@ class ServeMetrics:
         self.spec_rounds = 0
         self.spec_hist = [0] * (len(SPEC_ACCEPT_BUCKETS) + 1)
         self.spec_hist_sum = 0.0
+        # device roofline telemetry (serve/telemetry.py): exact byte/
+        # time ledgers (never trimmed — per-request attribution must
+        # keep summing to them) plus per-dispatch gauge windows and a
+        # real utilization histogram.  Empty/zero unless a
+        # TelemetryModel is attached to the engine.
+        self.roofline_ticks = 0
+        self.kv_read_bytes_total = 0.0
+        self.kv_write_bytes_total = 0.0
+        self.weight_bytes_total = 0.0
+        self.device_time_s_total = 0.0
+        self.hbm_gbps: float | None = None
+        self.roofline_gbps: list[float] = []
+        self.roofline_util: list[float] = []
+        self.mfu_tick: list[float] = []
+        self.util_hist = [0] * (len(ROOFLINE_UTIL_BUCKETS) + 1)
+        self.util_hist_sum = 0.0
 
     # -- record hooks (engine calls these) -----------------------------
     def on_submit(self, req: Request) -> None:
@@ -231,6 +260,34 @@ class ServeMetrics:
                 bisect.bisect_left(SPEC_ACCEPT_BUCKETS, float(accepted))
             ] += 1
             self.spec_hist_sum += accepted
+
+    def on_telemetry(self, tel: dict[str, Any]) -> None:
+        """One telemetry record (serve/telemetry.py): a roofline-graded
+        dispatch (``roofline: True`` — the unified tick's one dispatch
+        or the split tick's decode dispatch) feeds the per-tick gauges
+        and the utilization histogram; a totals-only record (split-path
+        prefill, whose wall includes host Python) feeds just the byte/
+        time ledgers, which per-request attribution sums back to."""
+        with self._lock:
+            self.kv_read_bytes_total += tel["kv_read_bytes"]
+            self.kv_write_bytes_total += tel["kv_write_bytes"]
+            self.weight_bytes_total += tel["weight_bytes"]
+            self.device_time_s_total += tel["device_time_s"]
+            self.hbm_gbps = tel.get("hbm_gbps", self.hbm_gbps)
+            if not tel.get("roofline", True):
+                return
+            self.roofline_ticks += 1
+            util = tel["roofline_util"]
+            self.roofline_gbps.append(tel["achieved_gbps"])
+            self.roofline_util.append(util)
+            self.mfu_tick.append(tel["mfu"])
+            self.util_hist[
+                bisect.bisect_left(ROOFLINE_UTIL_BUCKETS, util)
+            ] += 1
+            self.util_hist_sum += util
+            for vals in (self.roofline_gbps, self.roofline_util,
+                         self.mfu_tick):
+                self._trim(vals)
 
     def on_prefix(self, *, requested: int, hits: int) -> None:
         """One prefill's prefix-cache outcome: ``requested`` shareable
@@ -348,6 +405,22 @@ class ServeMetrics:
                 out["anomaly_ticks"] = dict(self.anomaly_ticks)
             if self.lifecycle_actions:
                 out["lifecycle_actions"] = dict(self.lifecycle_actions)
+            # roofline telemetry: emitted only once a graded dispatch
+            # ran (the spec/SLO discipline — fabricated zeros would
+            # read as a broken deployment on a fleet dashboard)
+            rf_gbps = list(self.roofline_gbps)
+            rf_util = list(self.roofline_util)
+            rf_mfu = list(self.mfu_tick)
+            if self.roofline_ticks:
+                out["roofline_ticks"] = self.roofline_ticks
+                out["hbm_gbps"] = self.hbm_gbps
+                out["kv_read_bytes_total"] = self.kv_read_bytes_total
+                out["kv_write_bytes_total"] = self.kv_write_bytes_total
+                out["weight_bytes_total"] = self.weight_bytes_total
+                out["device_time_s_total"] = self.device_time_s_total
+                out["roofline_gbps_last"] = rf_gbps[-1]
+                out["roofline_util_last"] = rf_util[-1]
+                out["mfu_last"] = rf_mfu[-1]
         out.update(_pcts(ttft, "ttft_s"))
         out.update(_pcts(decode, "decode_tok_s"))
         out.update(_pcts(qwait, "queue_wait_s"))
@@ -356,6 +429,9 @@ class ServeMetrics:
         out.update(_pcts(occ, "occupancy"))
         out.update(_pcts(act, "active_slots"))
         out.update(_pcts(kvb, "kv_bytes_tick"))
+        out.update(_pcts(rf_gbps, "roofline_gbps"))
+        out.update(_pcts(rf_util, "roofline_util"))
+        out.update(_pcts(rf_mfu, "mfu"))
         # *_last: the most recent per-tick sample — the live gauge a
         # scrape wants, vs the trace-wide percentiles above
         if qd:
@@ -502,6 +578,34 @@ class ServeMetrics:
                      "Error-budget burn rate per window (observed miss "
                      "rate / budgeted miss rate; >1 = overspending)",
                      burn)
+        # -- device roofline telemetry (only once a graded dispatch ran
+        # — serve/telemetry.py; constant zeros would read as a stalled
+        # device on a fleet dashboard)
+        if "roofline_ticks" in s:
+            emit("device_bytes_total", "counter",
+                 "Modeled HBM traffic by kind (analytic byte model, "
+                 "serve/telemetry.py)",
+                 [('{kind="kv_read"}', s["kv_read_bytes_total"]),
+                  ('{kind="kv_write"}', s["kv_write_bytes_total"]),
+                  ('{kind="weight"}', s["weight_bytes_total"])])
+            emit("device_time_seconds_total", "counter",
+                 "Measured dispatch-to-host-sync wall attributed to "
+                 "device work",
+                 [("", s["device_time_s_total"])])
+            emit("roofline_gbps", "gauge",
+                 "Achieved GB/s of the last graded dispatch (modeled "
+                 "bytes / measured wall)",
+                 [("", s["roofline_gbps_last"])])
+            emit("roofline_util", "gauge",
+                 "Achieved GB/s over the --hbm-gbps roofline, last "
+                 "graded dispatch",
+                 [("", s["roofline_util_last"])])
+            emit("mfu", "gauge",
+                 "Model FLOP utilization estimate, last graded dispatch",
+                 [("", s["mfu_last"])])
+            emit("hbm_gbps_target", "gauge",
+                 "The HBM roofline utilization is graded against",
+                 [("", s["hbm_gbps"] or 0.0)])
         if s.get("anomaly_ticks"):
             emit("anomaly_ticks_total", "counter",
                  "Ticks where the sentinel flagged this phase as an "
@@ -526,6 +630,9 @@ class ServeMetrics:
             spec_hist = list(self.spec_hist)
             spec_hist_sum = self.spec_hist_sum
             spec_rounds = self.spec_rounds
+            util_hist = list(self.util_hist)
+            util_hist_sum = self.util_hist_sum
+            roofline_ticks = self.roofline_ticks
 
         def emit_hist(name: str, help_: str, buckets: tuple,
                       counts: list[int], total: float) -> None:
@@ -555,6 +662,11 @@ class ServeMetrics:
                       "Accepted draft tokens per speculative verify "
                       "round",
                       SPEC_ACCEPT_BUCKETS, spec_hist, spec_hist_sum)
+        if roofline_ticks:
+            emit_hist("roofline_util_hist",
+                      "Roofline utilization per graded dispatch "
+                      "(achieved GB/s over --hbm-gbps)",
+                      ROOFLINE_UTIL_BUCKETS, util_hist, util_hist_sum)
 
         # -- trace-wide quantile gauges alongside the histograms (the
         # single-process view; percentile windows, see max_samples) and
@@ -569,6 +681,12 @@ class ServeMetrics:
             ("prefill_s",
              "Cumulative prefill dispatch time per request "
              "(re-prefills after preemption/recovery included)"),
+            ("roofline_gbps",
+             "Achieved-GB/s quantiles over the recorded dispatch "
+             "window"),
+            ("roofline_util",
+             "Roofline-utilization quantiles over the recorded "
+             "dispatch window"),
         ):
             samples = [(f'{{quantile="{q}"}}', s[f"{base}_{p}"])
                        for q, p in (("0.5", "p50"), ("0.9", "p90"),
@@ -608,6 +726,14 @@ class ServeMetrics:
             f"mean accept len {s['spec_accept_len_mean']:.2f})"
             if "spec_drafted_tokens" in s else ""
         )
+        roofline = (
+            f"\nroofline: {s['roofline_gbps_mean']:.2f} GB/s mean "
+            f"({s['roofline_util_mean']:.2%} of {s['hbm_gbps']:g} GB/s, "
+            f"p99 util {s.get('roofline_util_p99', 0.0):.2%}, "
+            f"mfu {s['mfu_mean']:.4%}) over {s['roofline_ticks']} "
+            "graded dispatches"
+            if "roofline_ticks" in s else ""
+        )
         return (
             f"requests: {s['submitted']} submitted, {s['finished']} finished"
             f"{aborts}, "
@@ -628,5 +754,5 @@ class ServeMetrics:
             f"p99 {g('occupancy_p99', '{:.2f}')}; "
             f"active_slots mean {g('active_slots_mean', '{:.2f}')}\n"
             f"kv MiB/tick mean {mb_tick}; prefix cache hit rate {prefix}"
-            f"{spec}"
+            f"{spec}{roofline}"
         )

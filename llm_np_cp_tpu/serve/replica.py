@@ -675,6 +675,28 @@ class ReplicaSet:
         out["prefix_blocks_hit"] = hit
         if req:
             out["prefix_hit_rate"] = hit / req
+        # fleet roofline telemetry (serve/telemetry.py): summed byte/
+        # time ledgers, with the aggregate utilization recomputed from
+        # the SUMS (a mean of per-replica ratios would weight an idle
+        # replica like a loaded one — the burn-rate discipline)
+        rf = [s for s in per if "roofline_ticks" in s]
+        if rf:
+            for key in ("roofline_ticks", "kv_read_bytes_total",
+                        "kv_write_bytes_total", "weight_bytes_total",
+                        "device_time_s_total"):
+                out[key] = sum(s[key] for s in rf)
+            dev = out["device_time_s_total"]
+            total_bytes = (out["kv_read_bytes_total"]
+                           + out["kv_write_bytes_total"]
+                           + out["weight_bytes_total"])
+            hbm = next(
+                (s["hbm_gbps"] for s in rf if s.get("hbm_gbps")), None
+            )
+            out["hbm_gbps"] = hbm
+            if dev > 0:
+                out["roofline_gbps"] = total_bytes / dev / 1e9
+                if hbm:
+                    out["roofline_util"] = out["roofline_gbps"] / hbm
         # fleet SLO accounting: summed verdicts, burn rates recomputed
         # from summed window totals (serve/slo.aggregate_slo)
         from llm_np_cp_tpu.serve.slo import aggregate_slo

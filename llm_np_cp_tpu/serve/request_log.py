@@ -21,7 +21,11 @@ needs in one place:
   Request timestamps that feed the trace spans, so log and trace agree
   by construction;
 - outcome       — ``reason`` (stop/length/aborted), token counts, and
-  the ``slo`` verdict (when a policy is configured).
+  the ``slo`` verdict (when a policy is configured);
+- cost          — device-cost attribution (serve/telemetry.py, when a
+  TelemetryModel is attached): the request's exact KV bytes read/
+  written plus its token-share of streamed weight bytes and measured
+  device time — the per-tenant cost basis.
 
 WRITER DISCIPLINE (the journal's, machine-checked by tools/lint R3's
 ``reqlog`` domain): the engine tick thread only ENQUEUES records under
@@ -85,6 +89,18 @@ def request_record(
             phases["ttft_s"] = req.first_token_time - base
         phases["decode_s"] = finish - req.first_token_time
     rec["phases"] = {k: round(v, 6) for k, v in phases.items()}
+    if req.device_time_s or req.kv_bytes_read or req.weight_bytes_amortized:
+        # device-cost attribution (serve/telemetry.py): the request's
+        # exact KV traffic plus its token-share of streamed weights and
+        # measured device wall — per-request sums conserve against the
+        # metrics ledgers (test-pinned), and per-tenant SLOs bill
+        # against these fields (ROADMAP item 2)
+        rec["cost"] = {
+            "kv_bytes_read": round(req.kv_bytes_read, 1),
+            "kv_bytes_written": round(req.kv_bytes_written, 1),
+            "weight_bytes_amortized": round(req.weight_bytes_amortized, 1),
+            "device_time_s": round(req.device_time_s, 9),
+        }
     if policy is not None:
         rec["slo"] = policy.verdict(req).to_dict()
     return rec

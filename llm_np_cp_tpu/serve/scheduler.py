@@ -121,6 +121,17 @@ class Request:
     draft_len: int = 0
     slot: int = -1  # decode slot while RUNNING
     n_preemptions: int = 0
+    # -- device-cost attribution (serve/telemetry.py) -----------------
+    # cumulative over the request's lifetime (preemption re-prefills
+    # keep adding — the cost was really paid): exact KV bytes its
+    # attention read / its tokens wrote, plus its token-share of each
+    # tick's streamed weight bytes and measured device wall.  Zero
+    # unless a TelemetryModel is attached; the canonical request log
+    # carries them (the per-tenant cost basis, ROADMAP item 2).
+    kv_bytes_read: float = 0.0
+    kv_bytes_written: float = 0.0
+    weight_bytes_amortized: float = 0.0
+    device_time_s: float = 0.0
     # -- metrics timestamps -------------------------------------------
     submit_time: float | None = None
     # first admission into a decode slot (queue_wait_s = admit_time -

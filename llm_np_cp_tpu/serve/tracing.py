@@ -146,6 +146,12 @@ class TraceRecorder:
         self._events: deque | list = (
             deque(maxlen=ring) if ring is not None else []
         )
+        # optional OTLP span sink (serve/otel.OtlpExporter): every event
+        # the recorder keeps is also offered to the exporter's pending
+        # queue (enqueue only — its writer thread does the IO).  None =
+        # one is-None check per event, the standard zero-overhead hook
+        # discipline (tools/lint R4 covers the ``otel`` hook)
+        self.otel: Any = None
         self.dropped = 0
         # rid → currently-open lifecycle phase name (exactly one per
         # live request; the http bracket span is tracked separately by
@@ -179,10 +185,14 @@ class TraceRecorder:
             self._push(ev)
 
     def _push(self, ev: dict) -> None:
-        # caller holds the lock
+        # caller holds the lock; the exporter's offer() is a single
+        # lock-protected append (recorder lock → exporter lock, never
+        # the reverse — the exporter never calls back into the recorder)
         if self.ring is not None and len(self._events) == self.ring:
             self.dropped += 1
         self._events.append(ev)
+        if self.otel is not None:
+            self.otel.offer(ev)
 
     # -- synchronous (thread-track) events -----------------------------
     def complete(

@@ -16,6 +16,8 @@ LINT_THREAD_DOMAINS = {
     "Router.*": "router",
     "Writer.*": "journal",
     "Controller.*": "lifecycle",
+    "Exporter._writer*": "otel",
+    "Exporter.*": "shared",
 }
 
 LINT_LOCKED_STATE = {
@@ -61,6 +63,15 @@ class TickLoop:
         self.engine.scheduler.queue.append(1)  # engine domain: NOT a finding
         self._wlive.clear()  # BITE journal-writer-owned state from engine domain
         self.controller._roll_active = True  # BITE lifecycle-owned state from engine domain
+        self.exporter._wopen.clear()  # BITE otel-writer-owned state from engine domain
+
+
+class Exporter:
+    def _writer_loop(self):
+        self._wopen[(1, "queued")] = {}  # otel domain owns its span map: NOT a finding
+
+    def offer(self, ev):
+        self._wopen[(2, "x")] = ev  # BITE writer-owned span map from the shared enqueue side
 
 
 class Controller:

@@ -17,6 +17,12 @@ class Engine:
     def step_unguarded_actions(self):
         self.actions.on_tick([], None)  # BITE actions hook unguarded
 
+    def step_unguarded_telemetry(self):
+        self.telemetry.mixed_tick_cost(self, [], [])  # BITE telemetry hook unguarded
+
+    def push_unguarded_otel(self, ev):
+        self.otel.offer(ev)  # BITE otel sink unguarded
+
     def step_guarded(self):
         if self.tracer is not None:
             self.tracer.instant("tick")  # guarded: NOT a finding

@@ -507,6 +507,50 @@ def _self_check() -> None:
         rebuilt.journal = None
     print(f"compile counts OK (journaled): {rebuilt.compile_counts()}")
 
+    # roofline telemetry + cost attribution + OTLP export are host-side
+    # only (serve/telemetry.py analytic byte model = numpy arithmetic,
+    # attribution = Request field adds, serve/otel.py = a writer thread
+    # hung off the recorder): attaching ALL of them and churning the
+    # prefill:decode composition must compile NOTHING after the warmed
+    # ladder, and a clone_fresh rebuild still shares the compiled step
+    from llm_np_cp_tpu.serve.otel import OtlpExporter
+    from llm_np_cp_tpu.serve.telemetry import TelemetryModel
+
+    eng = ServeEngine(
+        params, cfg, sampler=Sampler(kind="greedy"), max_slots=2,
+        num_blocks=32, block_size=8, max_seq_len=64,
+        cache_dtype=jnp.float32, mixed_step="on",
+        telemetry=TelemetryModel(cfg, params),
+        tracer=TraceRecorder(ring=50_000),
+    )
+    # a dead collector endpoint on purpose: export failures must stay a
+    # dropped-batch counter, never a compile or a crash
+    exporter = OtlpExporter(
+        "http://127.0.0.1:9/v1/traces", timeout_s=0.2,
+    ).attach(eng.tracer)
+    tel_prompts = [rng.integers(1, 200, size=n) for n in (21, 5, 12)]
+    eng.warmup([int(p.size) for p in tel_prompts], max_new_tokens=8)
+    warm = dict(eng.compile_counts())
+    with CompileCounter().watch() as counter:
+        for i, p in enumerate(tel_prompts):
+            eng.submit(p, 4 + i)
+        eng.run_until_complete()
+    assert counter.count == 0, (
+        f"telemetry+otel churn compiled: {counter.events}"
+    )
+    assert eng.compile_counts() == warm
+    snap = eng.metrics.snapshot()
+    assert snap.get("roofline_ticks", 0) > 0, "telemetry graded nothing"
+    assert all(
+        r.device_time_s > 0 for r in eng.scheduler.finished
+    ), "cost attribution left a request unbilled"
+    rebuilt = eng.clone_fresh()
+    assert rebuilt._mixed_step is eng._mixed_step, (
+        "telemetry-attached clone_fresh did not share the compiled step"
+    )
+    exporter.close()
+    print(f"compile counts OK (telemetry+otel): {eng.compile_counts()}")
+
     # rolling upgrade (serve/lifecycle + ReplicaSet.rolling_upgrade):
     # a same-shaped weight swap must compile NOTHING — params are jit
     # call arguments, every rolled replica adopts ONE shared step

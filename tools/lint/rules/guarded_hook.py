@@ -5,9 +5,11 @@ The serve stack's optional instruments — the ``tracer``
 (serve/faults.FaultInjector), the ``journal`` durable request journal
 (serve/journal.RequestJournal), the ``request_log`` canonical request
 log (serve/request_log.RequestLog), the ``sentinel`` tick anomaly
-detector, the ``slo`` goodput tracker (serve/slo.py) and the
-``actions`` lifecycle auto-action policy (serve/lifecycle.py) — are
-OFF by
+detector, the ``slo`` goodput tracker (serve/slo.py), the
+``actions`` lifecycle auto-action policy (serve/lifecycle.py), the
+``telemetry`` device roofline model (serve/telemetry.TelemetryModel)
+and the ``otel`` OTLP span sink (serve/otel.OtlpExporter, hung off the
+TraceRecorder) — are OFF by
 default, spelled as ``None`` attributes.  The zero-overhead contract is that every hook call sits
 behind an ``is None`` / ``is not None`` check in the same function, so
 instruments-off costs an attribute load and a branch: no dict built for
@@ -42,7 +44,7 @@ from tools.lint.core import (
 RULE_ID = "R4"
 
 HOOKS = ("tracer", "faults", "journal", "request_log", "sentinel", "slo",
-         "actions")
+         "actions", "telemetry", "otel")
 # engine methods where binding self.tracer/self.metrics/self.journal to
 # a local is fine: construction, cloning, and the warmup
 # suspend/restore swap — none of them run inside a supervised tick
@@ -166,7 +168,8 @@ class _Rule:
                 if chain is None or len(chain) != 2 or chain[0] != "self":
                     continue
                 if chain[1] not in ("tracer", "metrics", "journal",
-                                    "request_log", "actions"):
+                                    "request_log", "actions",
+                                    "telemetry"):
                     continue
                 if not any(isinstance(t, ast.Name) for t in node.targets):
                     continue

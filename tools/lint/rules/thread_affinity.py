@@ -68,6 +68,15 @@ DOMAIN_TABLE: tuple[tuple[str, str, str], ...] = (
     # the journal: engine-side hooks only enqueue under the lock)
     ("serve/request_log.py", "RequestLog._writer*", "reqlog"),
     ("serve/request_log.py", "*", "engine"),
+    # the OTLP exporter's WRITER THREAD owns the open-span map and the
+    # HTTP plumbing; offer() is called from WHATEVER thread holds the
+    # recorder (engine tick, event loop, supervisor), so the enqueue
+    # side is shared and everything it touches is lock-protected
+    ("serve/otel.py", "OtlpExporter._writer*", "otel"),
+    ("serve/otel.py", "OtlpExporter._convert", "otel"),
+    ("serve/otel.py", "OtlpExporter._span_from", "otel"),
+    ("serve/otel.py", "OtlpExporter._export", "otel"),
+    ("serve/otel.py", "*", "shared"),
     # the ROADMAP router-ownership domain: PrefixRouter's own methods
     # are the only code allowed to mutate routing state — the fleet is
     # loop-owned in HTTP mode (ReplicaRunner) and engine-owned in
@@ -134,6 +143,14 @@ REQLOG_STATE: tuple[tuple[str, ...], ...] = (
     ("_wlines",),
 )
 
+# otlp-exporter-writer-thread-owned state (serve/otel.py): the ``_w``
+# naming convention again — only the writer thread matches async
+# begin/end pairs in the open-span map.  Everything shared with the
+# offer() side goes through the lock-protected pending queue.
+OTEL_STATE: tuple[tuple[str, ...], ...] = (
+    ("_wopen",),
+)
+
 # lifecycle-controller-owned state (serve/lifecycle.py): the in-flight
 # roll flag and history — only LifecycleController methods (the
 # lifecycle domain) drive a roll; handlers and tick code must call
@@ -153,6 +170,8 @@ DOMAIN_OWNED: tuple[tuple[str, tuple, str], ...] = (
      "enqueue a record for the writer thread instead"),
     ("reqlog", REQLOG_STATE,
      "enqueue a record for the writer thread instead"),
+    ("otel", OTEL_STATE,
+     "offer() the event for the writer thread instead"),
     ("lifecycle", LIFECYCLE_STATE,
      "drive the roll through LifecycleController methods instead"),
 )
@@ -174,6 +193,10 @@ LOCK_STATE: tuple[dict, ...] = (
             "prefix_blocks_hit", "mixed_prefill_tokens",
             "mixed_decode_tokens", "t_start", "t_last",
             "anomaly_ticks", "lifecycle_actions",
+            "roofline_ticks", "kv_read_bytes_total",
+            "kv_write_bytes_total", "weight_bytes_total",
+            "device_time_s_total", "hbm_gbps", "roofline_gbps",
+            "roofline_util", "mfu_tick", "util_hist", "util_hist_sum",
         },
         # "caller holds the lock" helpers — annotated, not inferred
         "lock_assumed": {"_record_latencies", "_trim"},
@@ -215,6 +238,16 @@ LOCK_STATE: tuple[dict, ...] = (
         "lock": "_lock",
         "attrs": {"_pending", "_stopping", "n_records",
                   "n_write_errors"},
+        "lock_assumed": set(),
+    },
+    {
+        # the OTLP exporter's offer↔writer boundary: the pending queue
+        # and the ship/drop counters are the only shared state
+        "file": "serve/otel.py",
+        "class": "OtlpExporter",
+        "lock": "_lock",
+        "attrs": {"_pending", "_stopping", "n_spans", "n_batches",
+                  "n_dropped", "n_export_errors"},
         "lock_assumed": set(),
     },
     {

@@ -18,6 +18,9 @@ Checks (each only when its flag/keys are present):
 - ``--min-attainment F``        — slo_attainment >= F
 - ``--min-goodput F``           — goodput_tok_s >= F
 - ``--max-burn F``              — every slo_burn_rate_* <= F
+- ``--min-bandwidth-util F``    — roofline_util_mean >= F (the mean
+  roofline utilization recorded by ``--roofline`` telemetry; top-level
+  else the best leg's)
 - ``--max-p99-ttft-degradation R`` — rolling-upgrade mode, consuming
   the ``serve_rolling_upgrade`` bench leg: the roll must drop ZERO
   streams and its p99 TTFT must stay within R× the steady leg's
@@ -77,7 +80,8 @@ def slo_numbers(rec: dict) -> dict[str, float]:
         return None
 
     def take(d: dict, prefix: str = "") -> None:
-        for key in ("slo_attainment", "goodput_tok_s"):
+        for key in ("slo_attainment", "goodput_tok_s",
+                    "roofline_util_mean", "roofline_gbps_mean"):
             val = _num(d.get(key))
             if val is not None:
                 out.setdefault(prefix + key, val)
@@ -164,7 +168,8 @@ def run_gate(args: argparse.Namespace) -> int:
               f"{args.bench}", file=sys.stderr)
         return 2
     nums = slo_numbers(rec)
-    if not nums and args.max_p99_ttft_degradation is None:
+    if not nums and args.max_p99_ttft_degradation is None \
+            and args.min_bandwidth_util is None:
         print(f"slo-gate: {args.bench} carries no SLO numbers "
               "(slo_attainment / goodput_tok_s) — was the bench run "
               "with an SLO policy?", file=sys.stderr)
@@ -194,6 +199,24 @@ def run_gate(args: argparse.Namespace) -> int:
         for key, val in sorted(nums.items()):
             if "slo_burn_rate_" in key and val > args.max_burn:
                 _fail(failures, f"{key} {val:.3f} > max {args.max_burn}")
+    if args.min_bandwidth_util is not None:
+        # top-level first (the bench's headline mirror), else the best
+        # leg's — gating the best leg keeps "split leg is slower by
+        # design" captures honest without failing them
+        util = nums.get("roofline_util_mean")
+        if util is None:
+            legs = [v for k, v in nums.items()
+                    if k.endswith(".roofline_util_mean")]
+            util = max(legs) if legs else None
+        if util is None:
+            _fail(failures,
+                  "roofline_util_mean missing — was the bench run "
+                  "with --roofline telemetry?")
+        elif util < args.min_bandwidth_util:
+            _fail(failures,
+                  f"roofline_util_mean {util:.4f} < min "
+                  f"{args.min_bandwidth_util} (achieved bandwidth "
+                  "fell below the roofline-utilization floor)")
 
     if args.baseline:
         try:
@@ -246,6 +269,12 @@ def main(argv: list[str] | None = None) -> int:
                    help="minimum goodput_tok_s")
     p.add_argument("--max-burn", type=float, default=None,
                    help="maximum error-budget burn rate, any window")
+    p.add_argument("--min-bandwidth-util", type=float, default=None,
+                   metavar="F",
+                   help="minimum mean roofline utilization (achieved "
+                   "GB/s over --hbm-gbps, 0..1) recorded by --roofline "
+                   "telemetry; consumes the bench's roofline_util_mean "
+                   "(top-level, else the best leg's)")
     p.add_argument("--max-p99-ttft-degradation", type=float, default=None,
                    metavar="R",
                    help="rolling-upgrade mode: the roll leg's p99 TTFT "

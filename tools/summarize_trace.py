@@ -12,6 +12,9 @@ from ``GET /debug/trace``) and prints:
 - **top-K slowest ticks** — timestamp, duration, and the tick's args
   (active slots, queue depth, admissions), the starting point for any
   p99 hunt;
+- **roofline** (when the trace was recorded with ``--roofline``) —
+  per-tick achieved GB/s and roofline-utilization percentiles from the
+  telemetry tick args, split vs mixed ticks reported separately;
 - **per-request lifecycle table** — queued / prefill / decode (and, when
   the HTTP layer traced it, the accept→response bracket) per request,
   with eviction/recovery counts and the finish reason.
@@ -222,6 +225,49 @@ def mixed_utilization(events: list[dict]) -> dict[str, float] | None:
     return out
 
 
+def _pct(vals: list[float], q: float) -> float:
+    """Nearest-rank percentile over a non-empty list (stdlib-only — no
+    numpy import just to print a table)."""
+    vals = sorted(vals)
+    idx = min(int(round(q / 100.0 * (len(vals) - 1))), len(vals) - 1)
+    return vals[idx]
+
+
+def roofline(events: list[dict]) -> dict[str, dict[str, float]] | None:
+    """Roofline telemetry from the per-tick ``roofline_gbps``/
+    ``roofline_util`` args (serve/telemetry.py stamps them when
+    ``--roofline`` is on): achieved-GB/s and utilization percentiles,
+    split by tick kind — ``mixed`` (unified ticks carry
+    ``prefill_tokens``) vs ``split`` (phase-split decode dispatches).
+    None when no tick carries the args (telemetry was off)."""
+    out: dict[str, dict[str, float]] = {}
+    by_kind: dict[str, list[dict]] = defaultdict(list)
+    for ev in events:
+        if ev.get("ph") != "X" or ev.get("cat") != "tick":
+            continue
+        args = ev.get("args") or {}
+        if "roofline_util" not in args:
+            continue
+        kind = "mixed" if "prefill_tokens" in args else "split"
+        by_kind[kind].append(args)
+    for kind, ticks in by_kind.items():
+        gbps = [a["roofline_gbps"] for a in ticks]
+        util = [a["roofline_util"] for a in ticks]
+        out[kind] = {
+            "ticks": len(ticks),
+            "gbps_p50": _pct(gbps, 50),
+            "gbps_p90": _pct(gbps, 90),
+            "gbps_p99": _pct(gbps, 99),
+            "util_p50": _pct(util, 50),
+            "util_p99": _pct(util, 99),
+            "util_mean": sum(util) / len(util),
+            "device_s_total": sum(
+                a.get("device_time_s", 0.0) for a in ticks
+            ),
+        }
+    return out or None
+
+
 def slowest_ticks(events: list[dict], k: int) -> list[dict]:
     ticks = [e for e in events
              if e.get("ph") == "X" and e.get("cat") == "tick"]
@@ -289,6 +335,19 @@ def format_summary(events: list[dict], top: int = 5) -> str:
                 f"{util['spec_accept_tokens']} accepted verify tokens "
                 f"({util['spec_accept_rate']:.1%} accept rate, "
                 f"+{util['spec_accept_per_tick']:.2f} free tok/tick)"
+            )
+    roof = roofline(events)
+    if roof is not None:
+        lines.append("== roofline ==")
+        for kind in sorted(roof):
+            r = roof[kind]
+            lines.append(
+                f"{kind:<6} {r['ticks']:.0f} ticks: "
+                f"GB/s p50 {r['gbps_p50']:.3f}  p90 {r['gbps_p90']:.3f}"
+                f"  p99 {r['gbps_p99']:.3f}; util p50 "
+                f"{r['util_p50']:.2%}  p99 {r['util_p99']:.2%}  "
+                f"mean {r['util_mean']:.2%}; device "
+                f"{r['device_s_total'] * 1e3:.2f} ms"
             )
     lines.append(f"== top {top} slowest ticks ==")
     for ev in slowest_ticks(events, top):
