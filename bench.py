@@ -207,11 +207,12 @@ SERVE_CHAOS_CONFIGS = {
 
 # Unified-tick leg (ServeEngine mixed_step): the SAME long-prefill-heavy
 # Poisson trace (mixed chat+completion decode budgets, prompts skewed
-# long so admissions land mid-decode) replayed twice on one engine
-# geometry — phase-split tick vs unified mixed tick — so the ragged
-# kernel's headline claim is a measured delta on identical arrivals:
-# lower p99 TTFT at equal-or-better decode tok/s, with strictly fewer
-# device dispatches per tick.
+# long so admissions land mid-decode) replayed three times on one engine
+# geometry — phase-split tick, unified mixed tick (fused sampling
+# epilogue), unified tick with the XLA logits tail — so the ragged
+# kernel's headline claim AND the tick-tail fusion's Δhost_sync/
+# Δroofline_util are measured deltas on identical arrivals at token
+# parity.
 SERVE_MIXED_CONFIGS = {
     "serve_mixed_poisson": dict(model="llama1b", requests=32, rate=16.0,
                                 prompt_len=512, max_tokens=64, slots=8,
@@ -406,10 +407,10 @@ TIMEOUTS = {
     # arrival pacing (~2s traffic span each) on top of the serve compile
     # budget; the HTTP leg adds event-loop + SSE framing time per token
     "serve_http_poisson": 850,
-    # two trace replays (split + unified) on one param build, each with
-    # its own warmup — the unified leg warms one mixed_step compile per
-    # packed-width bucket
-    "serve_mixed_poisson": 850,
+    # three trace replays (split + unified-fused + unified-XLA-tail) on
+    # one param build, each with its own warmup — each unified leg warms
+    # one mixed_step compile per packed-width bucket
+    "serve_mixed_poisson": 1100,
     # two unified-tick replays (plain + spec) on one param build; the
     # spec leg's verify lanes widen the sample operands, so its bucket
     # warmup compiles its own mixed_step set
@@ -962,21 +963,28 @@ def run_serve_config(name: str) -> dict:
 
 
 def run_serve_mixed_config(name: str) -> dict:
-    """Unified ragged tick vs phase-split: ONE long-prefill-heavy
-    Poisson trace (prompts skewed toward the long end, mixed
-    chat+completion decode budgets) replayed through two engines of
-    identical geometry — ``mixed_step="off"`` (admission → prefill
-    chunks → grow → decode, one dispatch per phase) and
-    ``mixed_step="on"`` (one ragged mixed dispatch per tick with the
-    SLO token-budget planner).  The observables are the ISSUE's
-    acceptance targets: p99 TTFT (long prefills no longer stall
-    decoders), decode tok/s (equal or better), token parity between
-    legs, and device dispatches per tick (strictly fewer unified)."""
+    """Unified ragged tick vs phase-split, plus the tick-tail fusion
+    head-to-head: ONE long-prefill-heavy Poisson trace (prompts skewed
+    toward the long end, mixed chat+completion decode budgets) replayed
+    through three engines of identical geometry — ``mixed_step="off"``
+    (admission → prefill chunks → grow → decode, one dispatch per
+    phase), ``mixed_step="on"`` (one ragged mixed dispatch per tick
+    with the SLO token-budget planner; fused sampling epilogue when the
+    probe passes), and ``mixed_xla_tail`` (the same unified tick with
+    ``sample_epilogue="off"`` — the XLA final_logits+sampler oracle).
+    The observables are the ISSUE's acceptance targets: p99 TTFT,
+    decode tok/s, token parity between ALL legs, dispatches per tick
+    (strictly fewer unified), and for the fused-vs-unfused pair on
+    identical arrivals: Δhost_sync p99 + share, Δroofline utilization,
+    and the one-fetch ceiling (host_fetches <= 1 per tick,
+    trace-verified) — what ``tools/slo_gate.py --min-bandwidth-util``
+    gates on live captures."""
     import jax.numpy as jnp
     import numpy as np
 
     from llm_np_cp_tpu.ops.sampling import Sampler
-    from llm_np_cp_tpu.serve import ServeEngine, poisson_trace
+    from llm_np_cp_tpu.serve import ServeEngine, TraceRecorder, poisson_trace
+    from tools.summarize_trace import mixed_utilization
 
     t0 = time.perf_counter()
     spec = SERVE_MIXED_CONFIGS[name]
@@ -1018,7 +1026,12 @@ def run_serve_mixed_config(name: str) -> dict:
 
     per_leg: dict = {}
     tokens_by_leg: dict = {}
-    for leg, mode in (("split", "off"), ("mixed", "on")):
+    legs = (("split", "off", "auto"), ("mixed", "on", "auto"),
+            ("mixed_xla_tail", "on", "off"))
+    for leg, mode, epilogue in legs:
+        # the fused-vs-unfused pair reads its host_sync column from the
+        # trace plane (per-tick host_sync_us + the one-fetch ceiling)
+        tracer = TraceRecorder() if mode == "on" else None
         engine = ServeEngine(
             params, config,
             sampler=Sampler(kind="greedy"),
@@ -1029,7 +1042,9 @@ def run_serve_mixed_config(name: str) -> dict:
             prefill_chunk=chunk,
             cache_dtype=jnp.bfloat16,
             mixed_step=mode,
+            sample_epilogue=epilogue,
             telemetry=telemetry,
+            tracer=tracer,
         )
         engine.warmup([int(t["prompt"].size) for t in trace],
                       max_new_tokens=spec["max_tokens"])
@@ -1066,24 +1081,46 @@ def run_serve_mixed_config(name: str) -> dict:
             "mfu_mean": round(snap.get("mfu_mean", 0.0), 8),
             "hbm_gbps": snap.get("hbm_gbps"),
             "compile_counts": engine.compile_counts(),
+            "epilogue": engine.epilogue_impl,
         }
         if mode == "on":
             per_leg[leg]["ragged_attn_impl"] = engine.ragged_attn_impl
             per_leg[leg]["tick_token_budget"] = engine.tick_token_budget
             per_leg[leg]["buckets"] = list(engine.mixed_buckets)
+            util = mixed_utilization(tracer.events()) or {}
+            per_leg[leg]["host_sync_us_p99"] = round(
+                util.get("host_sync_us_p99", 0.0), 1)
+            per_leg[leg]["host_sync_share"] = round(
+                util.get("host_sync_share", 0.0), 4)
+            per_leg[leg]["host_fetches_max"] = util.get(
+                "host_fetches_max", 0)
         del engine
 
     parity = tokens_by_leg["split"] == tokens_by_leg["mixed"]
+    fused_parity = tokens_by_leg["mixed"] == tokens_by_leg["mixed_xla_tail"]
     m, s = per_leg["mixed"], per_leg["split"]
+    xt = per_leg["mixed_xla_tail"]
     return {
         "config": name,
-        "ok": all(r["ok"] for r in per_leg.values()) and parity,
+        "ok": (all(r["ok"] for r in per_leg.values()) and parity
+               and fused_parity),
         "requests": spec["requests"],
         "rate_rps": spec["rate"],
         "slots": spec["slots"],
         "pool_blocks": num_blocks,
         "block_size": bs,
         "token_parity_mixed_vs_split": parity,
+        # the tick-tail fusion pair: identical arrivals, fused epilogue
+        # vs the XLA logits tail — token parity is the non-negotiable
+        # bar, the deltas are the win (signs meaningful on live HBM;
+        # on CPU the fields prove the plumbing)
+        "token_parity_fused_vs_xla_tail": fused_parity,
+        "epilogue": m["epilogue"],
+        "host_sync_p99_delta_us": round(
+            xt["host_sync_us_p99"] - m["host_sync_us_p99"], 1),
+        "roofline_util_delta": round(
+            m["roofline_util_mean"] - xt["roofline_util_mean"], 8),
+        "host_fetches_max": m["host_fetches_max"],
         # headline: the unified tick's deltas on identical arrivals
         "ttft_s_p99": m["ttft_s_p99"],
         "ttft_s_p99_split": s["ttft_s_p99"],

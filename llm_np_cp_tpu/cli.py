@@ -165,6 +165,18 @@ def _add_serve_engine_flags(p: argparse.ArgumentParser,
                    "the kernel is rejected); 'off' is the phase-split "
                    "engine (--attn-impl/--decode-attn then select its "
                    "decode path)")
+    p.add_argument("--sample-epilogue", choices=["auto", "on", "off"],
+                   default="auto",
+                   help="fused sampling epilogue (tick-tail fusion): "
+                   "the step's final-norm → lm_head → sample chain runs "
+                   "as ONE Pallas kernel over vocab tiles, so the "
+                   "[rows, V] logits never materialize in HBM.  'auto' "
+                   "(default) fuses when the sample_epilogue probe "
+                   "passes AND the draw is bit-identical to the XLA "
+                   "tail (greedy sampler, float/int8 head); 'on' warns "
+                   "when it cannot fuse; 'off' forces the XLA "
+                   "final_logits+sampler tail (the parity oracle).  The "
+                   "banner reports the resolution as epilogue=fused|xla")
     p.add_argument("--tick-token-budget", type=int, default=0, metavar="N",
                    help="unified tick only: token budget per tick — "
                    "decode rows are budgeted first (never starved), "
@@ -708,6 +720,7 @@ def _build_serve_engine(args, params, config, *, prog: str,
         fault_injector=fault_injector,
         tracer=tracer,
         mixed_step=getattr(args, "mixed_step", "off"),
+        sample_epilogue=getattr(args, "sample_epilogue", "auto"),
         tick_token_budget=getattr(args, "tick_token_budget", 0) or None,
         mesh_plan=mesh_plan,
         mesh_devices=mesh_devices,
@@ -743,10 +756,12 @@ def _build_serve_engine(args, params, config, *, prog: str,
     if engine.mixed:
         print(f"[{prog}] unified tick ACTIVE: one mixed dispatch/tick, "
               f"budget {engine.tick_token_budget} tokens "
-              f"(ragged attention: {engine.ragged_attn_impl})")
+              f"(ragged attention: {engine.ragged_attn_impl}, "
+              f"epilogue={'fused' if engine.epilogue_impl == 'fused' else 'xla'})")
     elif getattr(args, "mixed_step", "off") == "auto":
         print(f"[{prog}] --mixed-step auto: ragged kernel unavailable; "
-              "using the phase-split tick")
+              "using the phase-split tick "
+              f"(epilogue={'fused' if engine.epilogue_impl == 'fused' else 'xla'})")
     if engine.spec_k:
         print(f"[{prog}] speculative serving ACTIVE: k={engine.spec_k} "
               "draft tokens/tick, prompt-lookup drafts verified in the "
@@ -866,7 +881,7 @@ def _run_serve_bench(argv: list[str], default_model: str) -> str:
         f"mixed:{engine.ragged_attn_impl}"
         f"(budget={engine.tick_token_budget})"
         if engine.mixed else "split"
-    )
+    ) + f",epilogue={engine.epilogue_impl}"
     topo = engine.mesh_desc or "single chip"
     if args.replicas > 1:
         if topo.startswith("pinned to"):
@@ -1004,7 +1019,8 @@ def _run_http_serve(argv: list[str], default_model: str) -> str:
     banner = (
         f"[serve] model={args.model} slots={args.slots} "
         f"pool={num_blocks}x{args.block_size} ({args.cache_dtype}), "
-        f"attn={engine.decode_attn_impl}, topo={topo}, "
+        f"attn={engine.decode_attn_impl}, "
+        f"epilogue={engine.epilogue_impl}, topo={topo}, "
         f"prefix_cache={'on' if args.prefix_cache else 'off'}, "
         f"max_queue={args.max_queue or 'unbounded'}, "
         f"supervision={'off' if not args.max_restarts else f'{args.max_restarts} restarts'}, "

@@ -219,19 +219,46 @@ def make_chunked_prefill_fn(
     return prefill_chunked
 
 
+def _make_sample_tail(
+    config: ModelConfig, sampler: Sampler, fused_epilogue: bool
+) -> Callable:
+    """``(params, key, fwd_out) → next_tok [B]`` — the decode tail.
+
+    fused_epilogue=True: ``fwd_out`` is the pre-final-norm hidden state
+    (``forward(..., skip_logits=True)``) and the tail is the ONE Pallas
+    ``sample_epilogue`` kernel (norm → lm_head → greedy sample streamed
+    over vocab tiles; ``[B, 1, V]`` logits never materialize) via the
+    shared ``transformer.sample_epilogue_tail`` invocation.  Callers
+    gate on ``transformer.epilogue_gate_error`` (Generator does) — the
+    draw is bit-identical to the sampler tail, pinned in tests.
+    False: the classic ``sampler(key, logits[:, -1])`` tail/oracle."""
+    if not fused_epilogue:
+        return lambda params, key, logits: sampler(key, logits[:, -1])
+    from llm_np_cp_tpu.models.transformer import sample_epilogue_tail
+
+    def tail(params: Params, key: jax.Array, hid: jnp.ndarray):
+        return sample_epilogue_tail(params, hid[:, -1], config)
+
+    return tail
+
+
 def make_decode_step_fn(
-    config: ModelConfig, sampler: Sampler, attn_impl: str = "xla"
+    config: ModelConfig, sampler: Sampler, attn_impl: str = "xla",
+    fused_epilogue: bool = False,
 ) -> Callable:
     """(params, tok [B], cache, key) → (next_tok [B], cache) — one token.
-    The cache is donated (updated in place); callers rebind it."""
+    The cache is donated (updated in place); callers rebind it.
+    ``fused_epilogue`` swaps the logits+sampler tail for the fused
+    sampling-epilogue kernel (see _make_sample_tail)."""
+    sample_tail = _make_sample_tail(config, sampler, fused_epilogue)
 
     @partial(jax.jit, donate_argnums=(2,))
     def step(params: Params, tok: jnp.ndarray, cache: KVCache, key: jax.Array):
-        logits, cache = forward(
+        out, cache = forward(
             params, tok[:, None], config, cache, logits_last_only=True,
-            attn_impl=attn_impl,
+            attn_impl=attn_impl, skip_logits=fused_epilogue,
         )
-        return sampler(key, logits[:, -1]), cache
+        return sample_tail(params, key, out), cache
 
     return step
 
@@ -242,6 +269,7 @@ def make_decode_loop_fn(
     stop_tokens: tuple[int, ...] = (),
     attn_impl: str = "xla",
     early_stop: bool = False,
+    fused_epilogue: bool = False,
 ) -> Callable:
     """(params, first_tok, cache, key, num_steps) →
     (tokens [B, num_steps], cache, steps_executed int32).
@@ -264,6 +292,7 @@ def make_decode_loop_fn(
     stops = jnp.asarray(stop_tokens, dtype=jnp.int32) if stop_tokens else None
     if early_stop and stops is None:
         raise ValueError("early_stop requires stop_tokens")
+    sample_tail = _make_sample_tail(config, sampler, fused_epilogue)
 
     @partial(jax.jit, static_argnums=(4,), donate_argnums=(2,))
     def decode_loop(
@@ -275,11 +304,12 @@ def make_decode_loop_fn(
         pad_offsets: jnp.ndarray | None = None,
     ):
         def step(tok, cache, done, k):
-            logits, cache = forward(
+            out, cache = forward(
                 params, tok[:, None], config, cache, logits_last_only=True,
                 pad_offsets=pad_offsets, attn_impl=attn_impl,
+                skip_logits=fused_epilogue,
             )
-            nxt = sampler(k, logits[:, -1])
+            nxt = sample_tail(params, k, out)
             if stops is not None:
                 nxt = jnp.where(done, tok, nxt)
                 done = done | jnp.any(nxt[:, None] == stops[None, :], axis=-1)
@@ -412,10 +442,27 @@ class Generator:
         else:
             self._prefill = make_prefill_fn(config, self.sampler, prefill_attn_impl)
         self.last_stream_stats: dict[str, Any] = {}
-        self._step = make_decode_step_fn(config, self.sampler, decode_attn_impl)
+        # fused sampling epilogue (tick-tail fusion, the serve engine's
+        # gate shared verbatim via transformer.epilogue_gate_error):
+        # greedy sampler + float/int8-"q" head + probe pass → the
+        # decode tail runs norm→lm_head→sample as one Pallas kernel and
+        # the [B, 1, V] logits never materialize; anything else keeps
+        # the logits+Sampler tail (the oracle)
+        from llm_np_cp_tpu.models.transformer import epilogue_gate_error
+
+        self.epilogue_impl = (
+            "fused"
+            if epilogue_gate_error(params, config, self.sampler.kind)
+            is None else "xla"
+        )
+        fused_epi = self.epilogue_impl == "fused"
+        self._step = make_decode_step_fn(
+            config, self.sampler, decode_attn_impl,
+            fused_epilogue=fused_epi,
+        )
         self._loop = make_decode_loop_fn(
             config, self.sampler, self.stop_tokens, decode_attn_impl,
-            early_stop=early_stop,
+            early_stop=early_stop, fused_epilogue=fused_epi,
         )
 
     def _init_cache(self, batch: int, max_seq_len: int) -> KVCache:

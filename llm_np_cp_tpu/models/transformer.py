@@ -232,6 +232,82 @@ def final_logits(
     return logits.astype(jnp.float32)
 
 
+def head_quant_mode(params: Params, config: ModelConfig) -> str | None:
+    """How the lm-head weight is stored: ``"float"`` (plain array),
+    ``"int8"`` (quant.py ``"q"`` payload — the fused sampling epilogue's
+    int8 kernel streams it), or ``None`` for payloads the epilogue
+    kernel does not cover (``q4``/``qa`` — those keep the XLA tail).
+    The ONE classification shared by the serve engine's epilogue gate
+    and the offline Generator, so the two cannot drift."""
+    w = (params.get("embed_tokens") if config.tie_word_embeddings
+         else params.get("lm_head"))
+    if w is None:
+        return None
+    if isinstance(w, dict):
+        return "int8" if "q" in w and "s" in w else None
+    return "float"
+
+
+def epilogue_params(
+    params: Params, config: ModelConfig
+) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray | None]:
+    """``(final-norm gamma, lm-head weight payload, [1, V] f32 scales
+    or None)`` — the leaves the fused sampling epilogue kernel streams
+    (ops/pallas/sample_epilogue.py).  Tied heads hand over the
+    embedding table ``[V, H]`` (per-row scales reshaped to the kernel's
+    per-column layout), untied heads ``[H, V]``.  Callers gate on
+    ``head_quant_mode`` first — this raises on unsupported payloads."""
+    w = (params["embed_tokens"] if config.tie_word_embeddings
+         else params["lm_head"])
+    if isinstance(w, dict):
+        return params["final_norm"], w["q"], w["s"].reshape(1, -1)
+    return params["final_norm"], w, None
+
+
+def sample_epilogue_tail(
+    params: Params, x: jnp.ndarray, config: ModelConfig
+) -> jnp.ndarray:
+    """Greedy-sample rows of PRE-final-norm hidden states ``x [N, H]``
+    through the fused sampling epilogue kernel → ``[N]`` int32 token
+    ids.  The ONE invocation shared by the serve engine's three step
+    builders and the offline Generator's decode tail, so the kernel
+    kwargs (norm eps/offset, softcap, head layout+scales) cannot drift
+    between paths — a new config knob lands here once or nowhere."""
+    from llm_np_cp_tpu.ops.pallas.sample_epilogue import sample_epilogue
+
+    gamma, w, w_scale = epilogue_params(params, config)
+    return sample_epilogue(
+        x, gamma, w, w_scale=w_scale,
+        tied=config.tie_word_embeddings,
+        eps=config.rms_norm_eps,
+        unit_offset=config.rms_norm_unit_offset,
+        logit_softcap=config.final_logit_softcapping,
+    )
+
+
+def epilogue_gate_error(
+    params: Params, config: ModelConfig, sampler_kind: str
+) -> str | None:
+    """None when the fused sampling epilogue reproduces this
+    (params, sampler) pair's draw bit-identically and the kernel is
+    available, else the reason it cannot — the ONE gate shared by
+    ``ServeEngine`` and the offline ``Generator`` (callers add their
+    own topology constraints, e.g. the engine's unsharded-mesh check,
+    on top)."""
+    if sampler_kind != "greedy":
+        return (f"sampler kind {sampler_kind!r} (only the greedy draw "
+                "is bit-identical to the streamed argmax)")
+    hq = head_quant_mode(params, config)
+    if hq is None:
+        return "unsupported lm-head payload (q4/qa heads keep the XLA tail)"
+    from llm_np_cp_tpu.ops.pallas.support import (
+        epilogue_kernel_name,
+        kernel_error,
+    )
+
+    return kernel_error(epilogue_kernel_name(hq == "int8"))
+
+
 def run_decoder_layer(
     w: Params,
     x: jnp.ndarray,
@@ -403,8 +479,17 @@ def forward(
     output_attentions: bool = False,
     output_router_losses: bool = False,
     attn_impl: str = "xla",
+    skip_logits: bool = False,
 ) -> tuple:
     """Run the decoder.
+
+    skip_logits=True returns the PRE-final-norm hidden states in the
+    logits slot ([B, S, H], or [B, 1, H] under logits_last_only)
+    instead of running ``final_logits`` — the fused sampling epilogue
+    (ops/pallas/sample_epilogue.py) consumes them and computes
+    norm→lm_head→sample in one kernel, so the ``[B, S, V]`` logits
+    never materialize.  Callers own the epilogue; everything else about
+    the forward (cache writes, masks, aux outputs) is unchanged.
 
     input_ids: [B, S] int32.
     cache: static KVCache, or None for the reference's cache-less
@@ -603,7 +688,10 @@ def forward(
     if output_attentions:
         aux["attentions"] = scan_out[pos_idx]  # [L, B, H, Sq, Skv]
 
-    logits = final_logits(params, x, config, last_only=logits_last_only)
+    if skip_logits:
+        logits = x[:, -1:, :] if logits_last_only else x
+    else:
+        logits = final_logits(params, x, config, last_only=logits_last_only)
 
     new_cache = None
     if cache is not None:

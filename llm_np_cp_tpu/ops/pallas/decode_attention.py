@@ -58,6 +58,59 @@ NEG_INF = float(jnp.finfo(jnp.float32).min)
 # and the compiler's own buffers.
 _VMEM_BUDGET_BYTES = 8 * 2**20
 
+# ----------------------------------------------------------------------
+# AMLA rescaling (PAPERS.md: "AMLA: MUL by ADD in FlashAttention
+# Rescaling").  The classic online-softmax block update rescales the
+# accumulator and normalizer with alpha = exp(m_prev - m_new) — two
+# full-width VPU multiplies (plus one transcendental) per block.  AMLA's
+# observation: if the running max is kept on the ln2 grid, alpha is an
+# EXACT power of two, and multiplying a float by 2^k is an integer ADD
+# on its exponent field.  The serving kernels below (_paged_kernel /
+# _ragged_kernel) use this additive-max formulation; quantizing the max
+# UP to the grid keeps every exp argument <= 0, so the only numerical
+# change is that p = exp(s - m) sits up to one octave lower — the
+# final acc/l ratio is mathematically unchanged (parity-pinned against
+# the XLA oracle at fp32/bf16/int8 in tests).  Validating the win on
+# real HBM traffic is recorded live-TPU debt (README/ROADMAP).
+# ----------------------------------------------------------------------
+_LN2 = 0.6931471805599453
+_LOG2E = 1.4426950408889634
+# exponent-step clamp: anything below this underflows every f32 anyway,
+# and the clamp keeps k * 2^23 inside int32 (250 * 2^23 < 2^31)
+_AMLA_KMIN = -250.0
+
+
+def _amla_max(m_prev: jnp.ndarray, s: jnp.ndarray) -> jnp.ndarray:
+    """New running max, snapped UP to the ln2 grid.  A fully-masked
+    block's tile max is NEG_INF (its grid snap overflows to -inf) and
+    the maximum keeps m_prev — the running max never leaves the grid
+    (or its NEG_INF init) once a real score has been seen."""
+    t = jnp.max(s, axis=-1, keepdims=True)
+    return jnp.maximum(m_prev, jnp.ceil(t * _LOG2E) * _LN2)
+
+
+def _amla_steps(m_prev: jnp.ndarray, m_new: jnp.ndarray) -> jnp.ndarray:
+    """Rescale exponent delta k <= 0 with alpha = 2^k: both maxes sit on
+    the ln2 grid, so the division is an exact integer.  The init case
+    (m_prev = NEG_INF) clips to the underflow floor, where the rescale
+    of the still-zero accumulator is a no-op by construction."""
+    d = (m_prev - m_new) * _LOG2E
+    d = jnp.where(jnp.isnan(d), 0.0, d)  # belt: -inf minus -inf
+    return jnp.round(jnp.clip(d, _AMLA_KMIN, 0.0)).astype(jnp.int32)
+
+
+def _amla_rescale(x: jnp.ndarray, k: jnp.ndarray) -> jnp.ndarray:
+    """``x * 2^k`` (k int32 <= 0) as an integer add on the f32 exponent
+    field — the MUL-by-ADD at the heart of AMLA.  Exponent underflow
+    (including x == 0 and the NEG_INF-init case) flushes to zero, which
+    is exactly what the multiplicative form's denormal underflow did."""
+    k23 = k * (1 << 23)
+    xi = jax.lax.bitcast_convert_type(x, jnp.int32)
+    ok = (xi & jnp.int32(0x7F800000)) + k23 > 0
+    return jnp.where(
+        ok, jax.lax.bitcast_convert_type(xi + k23, jnp.float32), 0.0
+    )
+
 
 def _decode_kernel(
     bounds_ref, *refs, scale: float, softcap: float | None, quantized: bool,
@@ -274,12 +327,16 @@ def _paged_kernel(
             s = jnp.tanh(s / softcap) * softcap
         s = jnp.where(mask, s, NEG_INF)
 
+        # AMLA additive-max update: the running max lives on the ln2
+        # grid, so the block rescale is an exponent-field integer add
+        # instead of an exp() + two full-width multiplies
         m_prev = m_ref[:]
-        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        m_new = _amla_max(m_prev, s)
         p = jnp.exp(s - m_new)
         p = jnp.where(mask, p, 0.0)
-        alpha = jnp.exp(m_prev - m_new)
-        l_ref[:] = l_ref[:] * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        k_steps = _amla_steps(m_prev, m_new)
+        l_ref[:] = (_amla_rescale(l_ref[:], k_steps)
+                    + jnp.sum(p, axis=-1, keepdims=True))
         pb = p.astype(vb.dtype)
         pv = jnp.concatenate(
             [
@@ -292,7 +349,7 @@ def _paged_kernel(
             ],
             axis=0,
         )
-        acc_ref[:] = acc_ref[:] * alpha + pv
+        acc_ref[:] = _amla_rescale(acc_ref[:], k_steps) + pv
         m_ref[:] = m_new
 
     @pl.when(j == nj - 1)
@@ -517,14 +574,17 @@ def _ragged_kernel(
         mask_full = jnp.concatenate([mask_qg] * kv_heads, axis=0)
         s = jnp.where(mask_full, s, NEG_INF)
 
+        # AMLA additive-max update (see _amla_rescale): ln2-grid max,
+        # block rescale = exponent-field integer add, not a multiply
         m_prev = m_ref[:]
-        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        m_new = _amla_max(m_prev, s)
         p = jnp.exp(s - m_new)
         # re-zero masked slots: a FULLY-masked query row (dead packing
         # lane) has m == NEG_INF and would otherwise get p == 1
         p = jnp.where(mask_full, p, 0.0)
-        alpha = jnp.exp(m_prev - m_new)
-        l_ref[:] = l_ref[:] * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        k_steps = _amla_steps(m_prev, m_new)
+        l_ref[:] = (_amla_rescale(l_ref[:], k_steps)
+                    + jnp.sum(p, axis=-1, keepdims=True))
         pb = p.astype(vb.dtype)
         pv = jnp.concatenate(
             [
@@ -537,7 +597,7 @@ def _ragged_kernel(
             ],
             axis=0,
         )  # [K*q_tile*G, D]
-        acc_ref[:] = acc_ref[:] * alpha + pv
+        acc_ref[:] = _amla_rescale(acc_ref[:], k_steps) + pv
         m_ref[:] = m_new
 
     @pl.when(j == nj - 1)

@@ -121,6 +121,42 @@ def _probe(kernel: str, backend: str) -> str | None:
                 q, pages, pages, tables, lengths, pads, scale=0.125,
                 interpret=False, **kwargs,
             ))
+        elif kernel in ("sample_epilogue", "sample_epilogue_int8"):
+            from llm_np_cp_tpu.ops.pallas.sample_epilogue import (
+                sample_epilogue,
+            )
+
+            # both head layouts at a multi-tile vocab with a ragged tail
+            # (300 = 2*128 + 44): the streamed lm-head BlockSpecs + the
+            # argmax/scratch layout class only a hardware compile
+            # validates.  5 rows exercise the sublane pad too.
+            n, h, v = 5, 64, 300
+            x = jnp.asarray(rng.standard_normal((n, h)), jnp.bfloat16)
+            gamma = jnp.asarray(rng.standard_normal((h,)), jnp.bfloat16)
+            tied_w = jnp.asarray(rng.standard_normal((v, h)), jnp.bfloat16)
+            untied_w = jnp.asarray(
+                rng.standard_normal((h, v)), jnp.bfloat16
+            )
+            kwargs = {}
+            if kernel.endswith("int8"):
+                from llm_np_cp_tpu.quant import quantize_array
+
+                qt = quantize_array(tied_w, axis=-1)
+                qu = quantize_array(untied_w, axis=-2)
+                tied_w, untied_w = qt["q"], qu["q"]
+                tied_kwargs = dict(w_scale=qt["s"].reshape(1, -1))
+                untied_kwargs = dict(w_scale=qu["s"].reshape(1, -1))
+            else:
+                tied_kwargs = untied_kwargs = {}
+            np.asarray(sample_epilogue(
+                x, gamma, tied_w, tied=True, eps=1e-6, block_v=128,
+                interpret=False, **tied_kwargs,
+            ))
+            np.asarray(sample_epilogue(
+                x, gamma, untied_w, tied=False, eps=1e-6,
+                logit_softcap=30.0, unit_offset=True, block_v=128,
+                interpret=False, **untied_kwargs,
+            ))
         elif kernel in ("ragged_paged_attention", "ragged_paged_attention_int8"):
             from llm_np_cp_tpu.ops.pallas.decode_attention import (
                 RAGGED_Q_TILE,
@@ -180,6 +216,15 @@ def ragged_kernel_name(int8_cache: bool) -> str:
         "ragged_paged_attention_int8" if int8_cache
         else "ragged_paged_attention"
     )
+
+
+def epilogue_kernel_name(int8_head: bool) -> str:
+    """Probe/kernel name for the fused sampling epilogue (final norm →
+    lm_head → greedy sample over vocab tiles) — same one-rule
+    discipline as ``paged_kernel_name``, shared by the serve engine's
+    epilogue gate and the offline Generator so the two can't drift.
+    ``int8_head``: the lm-head weight is a quant.py int8 payload."""
+    return "sample_epilogue_int8" if int8_head else "sample_epilogue"
 
 
 def kernel_error(kernel: str) -> str | None:

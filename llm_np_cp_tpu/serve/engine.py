@@ -145,6 +145,56 @@ def _ceil_to(n: int, g: int) -> int:
     return -(-n // g) * g
 
 
+def _stop_hits(samples: jnp.ndarray,
+               stop_tokens: tuple[int, ...]) -> jnp.ndarray:
+    """[.., W] bool — which sampled tokens are stop tokens (the static
+    stop set is tiny, so this is a handful of fused compares)."""
+    hit = jnp.zeros(samples.shape, jnp.bool_)
+    for t in stop_tokens:
+        hit = hit | (samples == jnp.int32(t))
+    return hit
+
+
+def _pack_sync(
+    samples: jnp.ndarray,       # [R, W] int32 sampled tokens
+    stop_hit: jnp.ndarray,      # [R, W] bool
+    accept: jnp.ndarray,        # [R] int32 leading draft matches
+) -> jnp.ndarray:
+    """The one-fetch host-sync contract: pack the tick's whole outcome
+    into ONE int32 array so ``host_sync`` is a single device→host
+    transfer.  Columns: ``[0:W)`` the sampled tokens, ``W`` a stop-hit
+    bitmask over those columns, ``W+1`` the advance watermark (tokens
+    the accept walk will emit this tick, pre-budget: up to the first
+    stop inside the accepted prefix, else accept+1), ``W+2`` the
+    accept length.  The split tick is the degenerate W=1 case
+    ([R, 4]: token, finished, watermark, accept).
+
+    The deliver walk reads the token and accept columns; finish/budget
+    semantics stay host-side in ``_maybe_finish`` (one source of
+    truth), so the stop-mask and watermark columns are currently
+    redundant with it — they ride along because the packed row IS the
+    contract (a consumer that wants the tick outcome without replaying
+    host logic — journal watermark batching, a future async deliver —
+    reads it from the same fetch), and three extra fused int32 ops per
+    row cost nothing next to the transfer they share."""
+    w = samples.shape[1]
+    bits = jnp.asarray([1 << j for j in range(w)], jnp.int32)
+    stop_mask = jnp.sum(
+        jnp.where(stop_hit, bits[None, :], 0), axis=1, dtype=jnp.int32
+    )
+    kcol = jnp.arange(w, dtype=jnp.int32)[None, :]
+    cand = stop_hit & (kcol <= accept[:, None])
+    advance = jnp.where(
+        jnp.any(cand, axis=1),
+        jnp.argmax(cand, axis=1).astype(jnp.int32) + 1,
+        accept + 1,
+    )
+    return jnp.concatenate(
+        [samples, stop_mask[:, None], advance[:, None],
+         accept[:, None]], axis=1,
+    )
+
+
 def _roofline_targs(tel: dict) -> dict:
     """The roofline slice of a tick's trace args (callers hold the
     tracer guard): what tools/summarize_trace.py's roofline section and
@@ -229,6 +279,7 @@ class ServeEngine:
         fault_injector: FaultInjector | None = None,
         tracer: TraceRecorder | None = None,
         mixed_step: str = "off",
+        sample_epilogue: str = "auto",
         tick_token_budget: int | None = None,
         mesh_plan: Any = None,
         mesh_devices: list | None = None,
@@ -253,8 +304,20 @@ class ServeEngine:
                 f"mixed_step must be 'auto', 'on' or 'off', got "
                 f"{mixed_step!r}"
             )
+        if sample_epilogue not in ("auto", "on", "off"):
+            raise ValueError(
+                f"sample_epilogue must be 'auto', 'on' or 'off', got "
+                f"{sample_epilogue!r}"
+            )
         if spec_k < 0:
             raise ValueError(f"spec_k must be >= 0, got {spec_k}")
+        if spec_k > 30:
+            # the one-fetch packed sync carries a per-row stop-hit
+            # BITMASK over the spec_k+1 sample columns in one int32
+            raise ValueError(
+                f"spec_k must be <= 30 (the packed host-sync stop mask "
+                f"is an int32 bitmask over spec_k+1 columns), got {spec_k}"
+            )
         if spec_k and spec_ngram < 2:
             # fail at construction, not at the first draft tick inside
             # the supervised tick thread (DraftState requires
@@ -508,6 +571,41 @@ class ServeEngine:
         # "strictly fewer dispatches per tick" claim
         self.n_dispatches = 0
 
+        # -- fused sampling epilogue gate (tick-tail fusion): the step's
+        # final-norm → lm_head → sample chain runs as ONE Pallas kernel
+        # over vocab tiles (ops/pallas/sample_epilogue.py) so the
+        # [rows, V] logits never materialize in HBM.  Fused only when
+        # the probe passes AND the draw is bit-identical to the XLA
+        # oracle — today that is the greedy sampler over a float or
+        # int8-"q" head on an unsharded (or placement-only) mesh; every
+        # other combination keeps the final_logits+Sampler tail, which
+        # remains the fallback/oracle everywhere ("off" forces it).
+        self.sample_epilogue_mode = sample_epilogue
+        self.epilogue_impl = "xla"
+        if sample_epilogue != "off":
+            from llm_np_cp_tpu.models.transformer import (
+                epilogue_gate_error,
+            )
+
+            if self.mesh is not None and self.mesh_plan.model > 1:
+                epi_err = ("model-sharded mesh (the kernel streams the "
+                           "full lm head; a TP-aware epilogue is open "
+                           "work)")
+            else:
+                epi_err = epilogue_gate_error(
+                    params, config, self.sampler.kind
+                )
+            if epi_err is None:
+                self.epilogue_impl = "fused"
+            elif sample_epilogue == "on":
+                import logging
+
+                logging.getLogger("llm_np_cp_tpu").warning(
+                    "sample_epilogue='on' but the fused epilogue "
+                    "cannot serve this engine (%s); using the XLA "
+                    "logits tail", epi_err,
+                )
+
         if self.mixed:
             # -- unified tick: ONE jitted program, bucketed packed width.
             # The temp prefill cache, scatter_prefill, gather_prefix and
@@ -550,6 +648,10 @@ class ServeEngine:
             self._sample_first = self._make_sample_first()
             self._scatter_prefill = self._make_scatter_prefill()
             self._gather_prefix = self._make_gather_prefix()
+        # one-fetch ledger, initialized after the step builders: the
+        # tick loops bump it at their single packed host_sync transfer
+        # and the tick trace args carry the per-tick count
+        self.n_host_fetches = 0
 
     def _make_buckets(self, budget: int, max_slots: int) -> tuple[int, ...]:
         """Packed-width buckets for the mixed step: a doubling ladder of
@@ -854,6 +956,8 @@ class ServeEngine:
         config, sampler = self.config, self.sampler
         bs = self.block_size
         quantized = self.cache_dtype == jnp.int8
+        use_epilogue = self.epilogue_impl == "fused"
+        stop_tokens = self.stop_tokens
         constrain_pages = self._constrain_pages
 
         @partial(jax.jit, donate_argnums=(1,))
@@ -883,20 +987,38 @@ class ServeEngine:
                 k_scale=gather(pages.k_scale, (kh,)) if quantized else None,
                 v_scale=gather(pages.v_scale, (kh,)) if quantized else None,
             )
-            logits, cache = forward(
-                params, toks[:, None], config, cache, logits_last_only=True,
-                pad_offsets=pads, attn_impl=attn_impl,
-            )
-            # Per-row keys from (request seed, content position): a
-            # request resumed after preemption replays the same stream,
-            # so stochastic samplers are preemption-transparent too.
             content_pos = lengths - pads
-            keys = jax.vmap(
-                lambda s, t: jax.random.fold_in(jax.random.PRNGKey(s), t)
-            )(seeds, content_pos)
-            nxt = jax.vmap(lambda k, lg: sampler(k, lg[None])[0])(
-                keys, logits[:, -1]
-            )
+            if use_epilogue:
+                # fused tail (greedy-exact — see _make_mixed_step): the
+                # [B, 1, V] logits never materialize
+                from llm_np_cp_tpu.models.transformer import (
+                    sample_epilogue_tail,
+                )
+
+                hid, cache = forward(
+                    params, toks[:, None], config, cache,
+                    logits_last_only=True, pad_offsets=pads,
+                    attn_impl=attn_impl, skip_logits=True,
+                )
+                nxt = sample_epilogue_tail(params, hid[:, -1], config)
+            else:
+                logits, cache = forward(
+                    params, toks[:, None], config, cache,
+                    logits_last_only=True, pad_offsets=pads,
+                    attn_impl=attn_impl,
+                )
+                # Per-row keys from (request seed, content position): a
+                # request resumed after preemption replays the same
+                # stream, so stochastic samplers are
+                # preemption-transparent too.
+                keys = jax.vmap(
+                    lambda s, t: jax.random.fold_in(
+                        jax.random.PRNGKey(s), t
+                    )
+                )(seeds, content_pos)
+                nxt = jax.vmap(lambda k, lg: sampler(k, lg[None])[0])(
+                    keys, logits[:, -1]
+                )
 
             # Extract the newly written K/V column (slot ``lengths`` per
             # row) from the gathered view and scatter it into the pool.
@@ -925,7 +1047,13 @@ class ServeEngine:
                     if quantized else None
                 ),
             )
-            return nxt, constrain_pages(new_pages)
+            # one-fetch contract, W=1 degenerate case: [B, 4] packed
+            # (token, stop-hit, watermark, accept)
+            packed = _pack_sync(
+                nxt[:, None], _stop_hits(nxt[:, None], stop_tokens),
+                jnp.zeros_like(nxt),
+            )
+            return packed, constrain_pages(new_pages)
 
         return decode_step
 
@@ -946,6 +1074,8 @@ class ServeEngine:
         quantized = self.cache_dtype == jnp.int8
         win = config.sliding_window
         num_layers = config.num_hidden_layers
+        use_epilogue = self.epilogue_impl == "fused"
+        stop_tokens = self.stop_tokens
         constrain_pages = self._constrain_pages
         attn_call = self._shard_attn(
             partial(
@@ -1049,16 +1179,31 @@ class ServeEngine:
                 v_scale=ys[3] if quantized else None,
             )
             new_pages = constrain_pages(new_pages)
-            logits = final_logits(params, x, config, last_only=True)
-            # same (seed, content position) key derivation as the gather
-            # step — the RNG stream is impl- and preemption-invariant
-            keys = jax.vmap(
-                lambda s, t: jax.random.fold_in(jax.random.PRNGKey(s), t)
-            )(seeds, content_pos)
-            nxt = jax.vmap(lambda k, lg: sampler(k, lg[None])[0])(
-                keys, logits[:, -1]
+            if use_epilogue:
+                # fused tail (greedy-exact — see _make_mixed_step)
+                from llm_np_cp_tpu.models.transformer import (
+                    sample_epilogue_tail,
+                )
+
+                nxt = sample_epilogue_tail(params, x[:, -1], config)
+            else:
+                logits = final_logits(params, x, config, last_only=True)
+                # same (seed, content position) key derivation as the
+                # gather step — the RNG stream is impl- and
+                # preemption-invariant
+                keys = jax.vmap(
+                    lambda s, t: jax.random.fold_in(
+                        jax.random.PRNGKey(s), t
+                    )
+                )(seeds, content_pos)
+                nxt = jax.vmap(lambda k, lg: sampler(k, lg[None])[0])(
+                    keys, logits[:, -1]
+                )
+            packed = _pack_sync(
+                nxt[:, None], _stop_hits(nxt[:, None], stop_tokens),
+                jnp.zeros_like(nxt),
             )
-            return nxt, new_pages
+            return packed, new_pages
 
         return decode_step
 
@@ -1088,6 +1233,8 @@ class ServeEngine:
         win = config.sliding_window
         num_layers = config.num_hidden_layers
         use_kernel = self.ragged_attn_impl == "pallas"
+        use_epilogue = self.epilogue_impl == "fused"
+        stop_tokens = self.stop_tokens
         big_win = jnp.int32(1 << 30)
         constrain_pages = self._constrain_pages
         attn_call = self._shard_attn(
@@ -1119,6 +1266,7 @@ class ServeEngine:
             last_idx: jnp.ndarray,    # [R, W] int32 packed sample indices
             sample_pos: jnp.ndarray,  # [R, W] int32 content pos of each
             seeds: jnp.ndarray,       # [R] uint32
+            verify_len: jnp.ndarray,  # [R] int32 live sample slots per row
         ):
             x = embed_inputs(params, tokens[None, :], config)  # [1, T, H]
             cos, sin = rope_cos_sin(
@@ -1196,7 +1344,7 @@ class ServeEngine:
                 v_scale=ys[3] if quantized else None,
             )
             new_pages = constrain_pages(new_pages)
-            # logits ONLY at each row's sample slots — [R, W] packed
+            # sampling ONLY at each row's sample slots — [R, W] packed
             # indices: column 0 is the plain sample (decode rows and
             # completing prefill segments), columns 1..k' are a
             # speculating row's verify positions; unused slots point at
@@ -1205,16 +1353,50 @@ class ServeEngine:
             # verify sample at position p is BIT-IDENTICAL to the plain
             # decode draw at p — the accept walk's whole parity story.
             xr = x[0][last_idx]  # [R, W, H]
-            logits = final_logits(params, xr, config)  # [R, W, V]
-            keys = jax.vmap(
-                lambda s, ps: jax.vmap(
-                    lambda t: jax.random.fold_in(jax.random.PRNGKey(s), t)
-                )(ps)
-            )(seeds, sample_pos)
-            nxt = jax.vmap(
-                jax.vmap(lambda k, lg: sampler(k, lg[None])[0])
-            )(keys, logits)
-            return nxt, new_pages
+            r_rows, w_cols = xr.shape[0], xr.shape[1]
+            if use_epilogue:
+                # fused tail: norm → lm_head → greedy sample streamed
+                # over vocab tiles — the [R, W, V] logits never exist
+                # (pinned by a jaxpr-inspection test).  Greedy ignores
+                # the RNG keys, so the draw is bit-identical to the
+                # oracle branch below.
+                from llm_np_cp_tpu.models.transformer import (
+                    sample_epilogue_tail,
+                )
+
+                nxt = sample_epilogue_tail(
+                    params, xr.reshape(r_rows * w_cols, -1), config
+                ).reshape(r_rows, w_cols)
+            else:
+                logits = final_logits(params, xr, config)  # [R, W, V]
+                keys = jax.vmap(
+                    lambda s, ps: jax.vmap(
+                        lambda t: jax.random.fold_in(
+                            jax.random.PRNGKey(s), t
+                        )
+                    )(ps)
+                )(seeds, sample_pos)
+                nxt = jax.vmap(
+                    jax.vmap(lambda k, lg: sampler(k, lg[None])[0])
+                )(keys, logits)
+            # in-graph accept walk + stop detection, so host_sync is ONE
+            # packed transfer: a verify slice's draft tokens ARE the
+            # packed input tokens at columns 1..k', so the longest
+            # matching prefix is computable without a host round-trip
+            drafts = tokens[last_idx[:, 1:]]  # [R, W-1]
+            jpos = jnp.arange(
+                max(w_cols - 1, 0), dtype=jnp.int32
+            )[None, :]
+            live = jpos < (verify_len[:, None] - 1)
+            lead = jnp.cumprod(
+                ((drafts == nxt[:, :-1]) & live).astype(jnp.int32),
+                axis=1,
+            )
+            accept = jnp.sum(lead, axis=1, dtype=jnp.int32)
+            packed = _pack_sync(
+                nxt, _stop_hits(nxt, stop_tokens), accept
+            )
+            return packed, new_pages
 
         return mixed_step
 
@@ -1523,6 +1705,7 @@ class ServeEngine:
             fault_injector=self.faults,
             tracer=self.tracer,
             mixed_step=self.mixed_step_mode,
+            sample_epilogue=self.sample_epilogue_mode,
             tick_token_budget=self.tick_token_budget or None,
             mesh_plan=self.mesh_plan,
             mesh_devices=self._mesh_devices,
@@ -1544,7 +1727,11 @@ class ServeEngine:
         eng.decode_degraded = self.decode_degraded
         eng._next_id = self._next_id
         if self.mixed:
-            if eng.mixed and eng.ragged_attn_impl == self.ragged_attn_impl:
+            if (
+                eng.mixed
+                and eng.ragged_attn_impl == self.ragged_attn_impl
+                and eng.epilogue_impl == self.epilogue_impl
+            ):
                 # same resolution → identical jaxpr; a runtime-degraded
                 # process (disable_kernel) rebuilds on the XLA fallback
                 # and compiles it once there, not per restart
@@ -1552,10 +1739,14 @@ class ServeEngine:
             return eng
         names = ["_prefill_step", "_sample_first", "_scatter_prefill",
                  "_gather_prefix"]
-        if eng.decode_attn_impl == self.decode_attn_impl:
+        if (
+            eng.decode_attn_impl == self.decode_attn_impl
+            and eng.epilogue_impl == self.epilogue_impl
+        ):
             # the gate can downgrade the clone (e.g. the paged kernel was
             # runtime-disabled between builds) — share the decode step
-            # only when both engines resolved to the same impl
+            # only when both engines resolved to the same impls (the
+            # attention AND the sampling epilogue live in its jaxpr)
             names.append("_decode_step")
         for name in names:
             setattr(eng, name, getattr(self, name))
@@ -1579,14 +1770,16 @@ class ServeEngine:
         if not self._same_placement(src):
             return
         if self.mixed and src.mixed \
-                and self.ragged_attn_impl == src.ragged_attn_impl:
+                and self.ragged_attn_impl == src.ragged_attn_impl \
+                and self.epilogue_impl == src.epilogue_impl:
             self._mixed_step = src._mixed_step
             return
         if not self.mixed and not src.mixed:
             for name in ("_prefill_step", "_sample_first",
                          "_scatter_prefill", "_gather_prefix"):
                 setattr(self, name, getattr(src, name))
-            if self.decode_attn_impl == src.decode_attn_impl:
+            if self.decode_attn_impl == src.decode_attn_impl \
+                    and self.epilogue_impl == src.epilogue_impl:
                 self._decode_step = src._decode_step
 
     def _same_placement(self, src: "ServeEngine") -> bool:
@@ -1920,6 +2113,7 @@ class ServeEngine:
         STARTED untraced never emits a garbage span if a tracer is
         attached mid-tick."""
         t0 = self.tracer.now_us() if self.tracer is not None else -1.0
+        fetches0 = self.n_host_fetches
         self._sweep_deadlines()
         admitted = self.scheduler.admit()
         t1 = self.tracer.now_us() if self.tracer is not None else -1.0
@@ -1977,7 +2171,7 @@ class ServeEngine:
                 tdev0 = self.clock()
             with (jax.profiler.TraceAnnotation("serve.decode_dispatch")
                   if self.tracer is not None else _NULL_CTX):
-                nxt, self.pool.pages = self._dispatch_decode(
+                out, self.pool.pages = self._dispatch_decode(
                     self._put(tables), self._put(lengths),
                     self._put(pads), self._put(toks),
                     self._put(seeds),
@@ -1990,7 +2184,14 @@ class ServeEngine:
                 hang = self.faults.trip("host_sync")
                 if hang is not None:
                     time.sleep(hang)
-            nxt_host = np.asarray(nxt)
+            # THE tick's one device→host transfer: the decode step
+            # returns the packed [B, 4] sync rows (token, stop-hit,
+            # watermark, accept — the mixed contract's W=1 case); the
+            # deliver loop below reads the token column and
+            # _maybe_finish re-derives finish host-side (see _pack_sync
+            # on the redundant columns)
+            out_host = np.asarray(out)
+            self.n_host_fetches += 1
             t5 = self.tracer.now_us() if self.tracer is not None else -1.0
             if cost is not None and self.telemetry is not None:
                 # attribution lands BEFORE the deliver loop so a
@@ -2000,7 +2201,7 @@ class ServeEngine:
                 self.telemetry.attribute(cost, tel["device_time_s"])
                 self.metrics.on_telemetry(tel)
             for r in running:
-                self._emit(r, int(nxt_host[r.slot]))
+                self._emit(r, int(out_host[r.slot, 0]))
                 self._maybe_finish(r)
 
         if self.journal is not None:
@@ -2022,6 +2223,12 @@ class ServeEngine:
                 "active_slots": len(running) if running else 0,
                 "queue_depth": self.scheduler.queue_depth,
                 "admitted": len(admitted),
+                # tick-tail observables (see _step_mixed): the one-fetch
+                # contract covers the DECODE fetch; the phase-split
+                # prefill's in-phase first-token sync is accounted to
+                # prefill and retired by the unified tick
+                "host_sync_us": round(max(t5 - t4, 0.0), 1),
+                "host_fetches": self.n_host_fetches - fetches0,
             }
             if tel is not None:
                 targs.update(_roofline_targs(tel))
@@ -2124,6 +2331,7 @@ class ServeEngine:
         last_idx = np.zeros((b, w_v), np.int32)
         sample_pos = np.zeros((b, w_v), np.int32)
         seeds = np.zeros(b, np.uint32)
+        verify_len = np.zeros(b, np.int32)
         cur = 0
         for r, toks, start_slot, n_verify in segs:
             n = toks.size
@@ -2148,6 +2356,7 @@ class ServeEngine:
                 tile_qlen[ti0 + k] = min(qb, n - k * qb)
             if n_verify:
                 first = n - n_verify  # verify slots = the last n_verify
+                verify_len[slot] = n_verify
                 for j in range(n_verify):
                     last_idx[slot, j] = cur + first + j
                     sample_pos[slot, j] = start_slot + first + j - r.pad
@@ -2155,7 +2364,7 @@ class ServeEngine:
         return tuple(self._put(a) for a in (
             tokens, positions, tok_blk, tok_off, tok_row, tok_slot,
             tok_live, tile_row, tile_qpos0, tile_qlen, tables, pads,
-            last_idx, sample_pos, seeds,
+            last_idx, sample_pos, seeds, verify_len,
         ))
 
     def _finish_mixed_prefill(self, req: Request, tok: int) -> None:
@@ -2259,6 +2468,7 @@ class ServeEngine:
         ``self.tracer`` is re-read at every hook for the same
         zombie-mute reason as the split tick."""
         t0 = self.tracer.now_us() if self.tracer is not None else -1.0
+        fetches0 = self.n_host_fetches
         self._sweep_deadlines()
         admitted = self.scheduler.admit()
         for req in admitted:
@@ -2308,7 +2518,7 @@ class ServeEngine:
             td0 = self.clock()
             with (jax.profiler.TraceAnnotation("serve.mixed_dispatch")
                   if self.tracer is not None else _NULL_CTX):
-                nxt, self.pool.pages = self._dispatch_mixed(
+                out, self.pool.pages = self._dispatch_mixed(
                     args, bool(prefill_segs)
                 )
             t4 = self.tracer.now_us() if self.tracer is not None else -1.0
@@ -2318,7 +2528,15 @@ class ServeEngine:
                 hang = self.faults.trip("host_sync")
                 if hang is not None:
                     time.sleep(hang)
-            nxt_host = np.asarray(nxt)
+            # THE tick's one device→host transfer (lint R2 allows
+            # exactly this fetch): the step packed samples + stop mask
+            # + watermark + accept length into one int32 array; the
+            # accept walk below reads the token + accept columns
+            # host-side (see _pack_sync on the other two)
+            out_host = np.asarray(out)
+            self.n_host_fetches += 1
+            nxt_host = out_host[:, : self._spec_w]
+            accept_host = out_host[:, self._spec_w + 2]
             t5 = self.tracer.now_us() if self.tracer is not None else -1.0
             if cost is not None and self.telemetry is not None:
                 # attribution lands BEFORE the deliver walks so a
@@ -2350,15 +2568,19 @@ class ServeEngine:
                 # stream emits at that position — walk while the drafts
                 # match, stop at the first correction (which is itself
                 # a verified emission), a stop token, or the budget.
+                # The match count arrived IN the packed fetch (the step
+                # compares its own draft inputs against its samples),
+                # so the walk reads host-side slices — no recompare.
                 # Rejected drafts' K/V writes sit past the new
                 # cache_len and are overwritten before ever attended.
-                draft = r.extra.pop("spec_draft")
+                r.extra.pop("spec_draft")
+                n_match = int(accept_host[r.slot])
                 w = 1 + r.draft_len
                 acc = 0
                 for j in range(w):
                     tok = int(nxt_host[r.slot, j])
                     self._emit(r, tok)
-                    if j < w - 1 and int(draft[j]) == tok:
+                    if j < n_match:
                         # the draft paid off even when this token ENDS
                         # the stream (a drafted stop token) — count it
                         # before the finish check, or accepted/rejected
@@ -2405,6 +2627,12 @@ class ServeEngine:
                 "admitted": len(admitted),
                 "prefill_tokens": n_prefill_tok,
                 "decode_tokens": n_decode_tok,
+                # the tick-tail observables: host_sync wall (µs) and the
+                # number of device→host transfers this tick — the
+                # one-fetch contract says the latter is exactly 1 on
+                # dispatching ticks (bench + tests pin it)
+                "host_sync_us": round(max(t5 - t4, 0.0), 1),
+                "host_fetches": self.n_host_fetches - fetches0,
             }
             if self.spec_k:
                 # the draft/verify split for summarize_trace and the
@@ -2472,23 +2700,43 @@ class ServeEngine:
             return self._mixed_step(self.params, self.pool.pages, *args)
 
     def _degrade_mixed(self, reason: str) -> bool:
-        """Pallas ragged attention → XLA fallback, process-wide (the
-        paged decode step's degradation discipline applied to the
-        unified tick).  Returns False when already on the fallback."""
-        if self.ragged_attn_impl != "pallas":
-            return False
-        from llm_np_cp_tpu.ops.pallas.support import (
-            disable_kernel,
-            ragged_kernel_name,
-        )
+        """Pallas → XLA fallback for the unified tick, process-wide
+        (the paged decode step's degradation discipline).  The tick is
+        ONE program, so its Pallas kernels — ragged attention AND the
+        fused sampling epilogue — degrade as a unit: the host cannot
+        attribute a dispatch fault to one kernel inside the jaxpr, and
+        each has its own XLA sibling.  Returns False when already fully
+        on the fallback."""
+        if self.ragged_attn_impl == "pallas" or self.epilogue_impl == "fused":
+            from llm_np_cp_tpu.ops.pallas.support import (
+                disable_kernel,
+                epilogue_kernel_name,
+                ragged_kernel_name,
+            )
 
-        disable_kernel(
-            ragged_kernel_name(self.cache_dtype == jnp.int8), reason
-        )
-        self.decode_degraded = reason
-        self.ragged_attn_impl = "xla"
-        self._mixed_step = self._make_mixed_step()
-        return True
+            if self.ragged_attn_impl == "pallas":
+                disable_kernel(
+                    ragged_kernel_name(self.cache_dtype == jnp.int8),
+                    reason,
+                )
+                self.ragged_attn_impl = "xla"
+            if self.epilogue_impl == "fused":
+                from llm_np_cp_tpu.models.transformer import (
+                    head_quant_mode,
+                )
+
+                disable_kernel(
+                    epilogue_kernel_name(
+                        head_quant_mode(self.params, self.config)
+                        == "int8"
+                    ),
+                    reason,
+                )
+                self.epilogue_impl = "xla"
+            self.decode_degraded = reason
+            self._mixed_step = self._make_mixed_step()
+            return True
+        return False
 
     def _kv_bytes_tick_mixed(
         self,
@@ -2523,13 +2771,13 @@ class ServeEngine:
             np.zeros((b, mb), np.int32), np.zeros(b, np.int32),
             np.zeros((b, self._spec_w), np.int32),
             np.zeros((b, self._spec_w), np.int32),
-            np.zeros(b, np.uint32),
+            np.zeros(b, np.uint32), np.zeros(b, np.int32),
         )
-        nxt, self.pool.pages = self._mixed_step(
+        out, self.pool.pages = self._mixed_step(
             self.params, self.pool.pages,
             *(self._put(a) for a in zeros),
         )
-        np.asarray(nxt)  # block until the compile lands
+        np.asarray(out)  # block until the compile lands
 
     def _dispatch_decode(self, *args: jnp.ndarray) -> tuple:
         """One decode dispatch with runtime kernel degradation: if the
@@ -2563,24 +2811,45 @@ class ServeEngine:
             return self._decode_step(self.params, self.pool.pages, *args)
 
     def _degrade_decode(self, reason: str) -> bool:
-        """Paged → gather runtime fallback.  Returns False when there is
-        no fallback (already on a gather impl)."""
-        if self.decode_attn_impl != "paged":
-            return False
-        from llm_np_cp_tpu.ops.pallas.support import (
-            disable_kernel,
-            paged_kernel_name,
-        )
+        """Paged attention → gather AND fused epilogue → XLA tail,
+        process-wide, as a unit (the step is one program — see
+        ``_degrade_mixed``).  Returns False when there is nothing left
+        to fall back to (gather impl with the XLA tail)."""
+        if self.decode_attn_impl == "paged" or self.epilogue_impl == "fused":
+            from llm_np_cp_tpu.ops.pallas.support import (
+                disable_kernel,
+                epilogue_kernel_name,
+                paged_kernel_name,
+            )
 
-        # process-wide: a supervisor rebuild (clone_fresh) and any future
-        # engine in this process must not re-select the faulted kernel
-        disable_kernel(
-            paged_kernel_name(self.cache_dtype == jnp.int8), reason
-        )
-        self.decode_degraded = reason
-        self.decode_attn_impl = "xla"
-        self._decode_step = self._make_decode_step("xla")
-        return True
+            # process-wide: a supervisor rebuild (clone_fresh) and any
+            # future engine in this process must not re-select the
+            # faulted kernel
+            if self.decode_attn_impl == "paged":
+                disable_kernel(
+                    paged_kernel_name(self.cache_dtype == jnp.int8),
+                    reason,
+                )
+                self.decode_attn_impl = "xla"
+            if self.epilogue_impl == "fused":
+                from llm_np_cp_tpu.models.transformer import (
+                    head_quant_mode,
+                )
+
+                disable_kernel(
+                    epilogue_kernel_name(
+                        head_quant_mode(self.params, self.config)
+                        == "int8"
+                    ),
+                    reason,
+                )
+                self.epilogue_impl = "xla"
+            self.decode_degraded = reason
+            self._decode_step = self._make_decode_step(
+                self.decode_attn_impl
+            )
+            return True
+        return False
 
     def _kv_bytes_tick(self, running: list[Request]) -> int:
         """K/V bytes this tick's decode attention touches — the

@@ -22,6 +22,15 @@ trace.  This module closes that gap with an ANALYTIC byte/FLOP model:
 - **FLOPs** — ``2 * active_params * tokens`` (attention FLOPs are
   second-order at serving context lengths and deliberately left out of
   the estimate — the model is for MFU *trend*, not a FLOP audit).
+- **sampling-tail traffic** — the XLA epilogue materializes
+  ``[rows, V]`` float32 logits (lm_head write + sampler read-back);
+  that rides the weight-bytes term so attribution/conservation follow
+  for free.  The FUSED epilogue (``ServeEngine.epilogue_impl ==
+  "fused"``) streams lm_head tiles through VMEM and pays ZERO here —
+  the model must never bill phantom logits traffic the fused kernel
+  retired (``_epilogue_logits_bytes`` is the one rule; the engine's
+  kv-bytes gauges keep delegating here, so gauge and model cannot
+  drift).
 
 Combined with the measured dispatch→host-sync wall of the SAME tick,
 that yields **achieved GB/s**, **roofline utilization** vs
@@ -215,6 +224,20 @@ def split_tick_kv_read(
     return int(total_f), per
 
 
+def _epilogue_logits_bytes(eng: Any, sample_rows: int) -> float:
+    """HBM traffic of the step's SAMPLING TAIL: the XLA epilogue
+    materializes ``[sample_rows, V]`` float32 logits (written by the
+    lm_head einsum, read back by the sampler — 8 bytes per pair, every
+    slot including inactive ones: the step samples at full static
+    width).  The fused epilogue never leaves VMEM with them, so it
+    pays zero — billing the difference is exactly what makes the
+    fused-vs-unfused roofline delta visible to ``slo_gate
+    --min-bandwidth-util``."""
+    if getattr(eng, "epilogue_impl", "xla") == "fused":
+        return 0.0
+    return float(sample_rows * eng.config.vocab_size * 4 * 2)
+
+
 class TelemetryModel:
     """The analytic cost model, frozen at engine-build time from the
     params tree and config.  Methods take the engine (geometry and
@@ -271,14 +294,20 @@ class TelemetryModel:
                 + tokens * self.embed_row_bytes)
 
     def _cost(self, kind: str, rows: list, kv_read: float,
-              n_dispatches: int = 1) -> dict[str, Any]:
+              n_dispatches: int = 1,
+              tail_bytes: float = 0.0) -> dict[str, Any]:
         tokens = sum(t for _, t, _, _ in rows)
         return {
             "kind": kind,
             "tokens": tokens,
             "kv_read_bytes": kv_read,
             "kv_write_bytes": float(sum(w for _, _, _, w in rows)),
-            "weight_bytes": float(self.weight_bytes(tokens, n_dispatches)),
+            # the sampling tail's logits traffic (zero when fused)
+            # rides the weight term: same streamed-per-dispatch shape,
+            # and attribution/conservation follow unchanged
+            "weight_bytes": float(
+                self.weight_bytes(tokens, n_dispatches) + tail_bytes
+            ),
             "flops": 2.0 * self.n_flop_params * tokens,
             "rows": rows,
         }
@@ -300,7 +329,12 @@ class TelemetryModel:
         for r, n in prefill_segs:
             rows.append((r, n, float(per_read[r.req_id]),
                          float(n * wslot)))
-        return self._cost("mixed", rows, float(kv_read))
+        return self._cost(
+            "mixed", rows, float(kv_read),
+            tail_bytes=_epilogue_logits_bytes(
+                eng, eng.scheduler.max_slots * eng._spec_w
+            ),
+        )
 
     def split_tick_cost(self, eng: Any, running: list) -> dict[str, Any]:
         """The phase-split decode dispatch's bill (prefill dispatches
@@ -313,7 +347,12 @@ class TelemetryModel:
             (r, 1, float(per_read[r.req_id]), float(wslot))
             for r in running
         ]
-        return self._cost("decode", rows, float(kv_read))
+        return self._cost(
+            "decode", rows, float(kv_read),
+            tail_bytes=_epilogue_logits_bytes(
+                eng, eng.scheduler.max_slots
+            ),
+        )
 
     # ------------------------------------------------------------------
     def finish(self, cost: dict[str, Any],

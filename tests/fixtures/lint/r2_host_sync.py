@@ -19,15 +19,17 @@ class FakeEngine:
         nxt.block_until_ready()  # BITE block_until_ready
         t2 = self.tracer.now_us() if self.tracer is not None else -1.0
         nxt_host = np.asarray(nxt)  # designated host_sync: NOT a finding
+        fin_host = np.asarray(nxt)  # BITE second fetch after the designated one
         t3 = self.tracer.now_us() if self.tracer is not None else -1.0
         self._deliver(nxt_host, early, depth)
+        wm = self.watermark_dev.item()  # BITE third sync in deliver
         t4 = self.tracer.now_us() if self.tracer is not None else -1.0
         if self.tracer is not None:
             self.tracer.tick(t0, (
                 ("admission", t0, t1), ("decode_dispatch", t1, t2),
                 ("host_sync", t2, t3), ("deliver", t3, t4),
             ))
-        return True
+        return int(fin_host[0]) + wm  # host-side read: NOT a finding
 
     def _admit(self):
         import jax

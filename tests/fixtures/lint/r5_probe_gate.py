@@ -6,6 +6,7 @@ from llm_np_cp_tpu.ops.pallas.decode_attention import (
     paged_decode_attention,
     ragged_paged_attention,
 )
+from llm_np_cp_tpu.ops.pallas.sample_epilogue import sample_epilogue
 from llm_np_cp_tpu.ops.pallas.support import kernel_available
 
 
@@ -23,3 +24,9 @@ class BadEngine:
     def prefill(self, q, k, v):
         # module-attribute access must not bypass the rule
         return fa_mod.flash_attention(q, k, v, scale=0.1)  # BITE
+
+    def sample(self, x, gamma, w):
+        # the fused sampling epilogue is probe-gated like every kernel:
+        # an unconditional call must bite (R5 parses the gated-kernel
+        # set out of _probe, so the new probes cover it automatically)
+        return sample_epilogue(x, gamma, w, tied=True, eps=1e-6)  # BITE

@@ -151,9 +151,13 @@ def test_serve_smoke_offline():
 
 def test_serve_mixed_smoke_offline():
     """The unified-tick child: the same long-prefill-heavy trace through
-    the phase-split and mixed engines — token parity between the legs,
-    at most one dispatch per unified tick (strictly fewer total than
-    phase-split), and one mixed_step compile per packed-width bucket."""
+    the phase-split, fused-epilogue, and XLA-tail engines — token parity
+    across ALL legs, at most one dispatch per unified tick (strictly
+    fewer total than phase-split), one mixed_step compile per
+    packed-width bucket, and the tick-tail fusion observables: the
+    fused leg resolves epilogue=fused, makes exactly ONE device fetch
+    per tick (trace-verified host_sync column), and the Δhost_sync/
+    Δroofline_util pair is reported for slo_gate."""
     res = bench._spawn("smoke_serve_mixed", 600, env={"BENCH_PLATFORM": "cpu"})
     assert res.get("ok") is True, res
     assert res["token_parity_mixed_vs_split"] is True
@@ -167,6 +171,23 @@ def test_serve_mixed_smoke_offline():
             <= len(legs["mixed"]["buckets"]))
     assert legs["split"]["compile_counts"]["decode_step"] == 1
     assert res["ragged_kernel_probe"] == "ok"  # interpret mode on CPU
+    # the fused-vs-unfused pair (tick-tail fusion acceptance): token
+    # parity at identical arrivals, the one-fetch ceiling on BOTH
+    # unified legs, no extra compiles on the fused path, and the delta
+    # fields slo_gate consumes present
+    assert res["token_parity_fused_vs_xla_tail"] is True
+    assert legs["mixed"]["epilogue"] == "fused"  # interpret-mode probe
+    assert legs["mixed_xla_tail"]["epilogue"] == "xla"
+    assert legs["mixed"]["host_fetches_max"] == 1
+    assert legs["mixed_xla_tail"]["host_fetches_max"] == 1
+    assert legs["mixed"]["host_sync_us_p99"] > 0
+    assert 0.0 <= legs["mixed"]["host_sync_share"] <= 1.0
+    assert legs["mixed"]["dispatches_per_tick"] <= 1.0
+    assert set(legs["mixed_xla_tail"]["compile_counts"]) == {"mixed_step"}
+    assert (legs["mixed"]["compile_counts"]["mixed_step"]
+            == legs["mixed_xla_tail"]["compile_counts"]["mixed_step"])
+    assert "host_sync_p99_delta_us" in res
+    assert "roofline_util_delta" in res
 
 
 def test_serve_spec_smoke_offline():

@@ -159,14 +159,49 @@ def test_decode_fault_degrades_paged_to_gather_token_identical(tiny):
 
 
 def test_decode_fault_on_gather_impl_propagates(tiny):
-    """No fallback below gather: the fault surfaces (and a supervisor,
-    not the engine, owns it)."""
+    """No fallback below gather + the XLA sampling tail: the fault
+    surfaces (and a supervisor, not the engine, owns it).  With the
+    fused epilogue active a gather engine still has ONE step down —
+    the epilogue degrades to the XLA tail and the tick retries — so
+    the floor is pinned with ``sample_epilogue="off"``."""
     cfg, params = tiny
-    engine = _engine(cfg, params,
+    engine = _engine(cfg, params, sample_epilogue="off",
                      fault_injector=FaultInjector("decode@1"))
+    assert engine.epilogue_impl == "xla"
     engine.submit(np.asarray([3, 5, 7], np.int32), 4)
     with pytest.raises(FaultInjected):
         engine.run_until_complete()
+
+
+def test_decode_fault_degrades_fused_epilogue_then_propagates(tiny):
+    """The new floor semantics: on a gather engine with the fused
+    epilogue, the FIRST decode fault degrades the epilogue to the XLA
+    tail (process-wide, requests finish token-identically); once fully
+    on XLA the next fault propagates."""
+    cfg, params = tiny
+    inj = FaultInjector("decode@2")
+    engine = _engine(cfg, params, fault_injector=inj)
+    assert engine.epilogue_impl == "fused"
+    rng = np.random.default_rng(4)
+    prompts = [rng.integers(1, cfg.vocab_size, size=n) for n in (5, 9)]
+    reqs = [engine.submit(p, 5, seed=i) for i, p in enumerate(prompts)]
+    try:
+        engine.run_until_complete()
+        assert engine.epilogue_impl == "xla"
+        assert engine.decode_degraded and "injected" in engine.decode_degraded
+        assert support.kernel_error("sample_epilogue") is not None
+        for req, p in zip(reqs, prompts):
+            assert req.generated == _offline(cfg, params, p, 5)
+        # nothing left below gather+XLA-tail: the next fault surfaces
+        engine.faults = FaultInjector("decode@1")
+        engine.submit(prompts[0], 3)
+        with pytest.raises(FaultInjected):
+            engine.run_until_complete()
+    finally:
+        # surgical: other tests in this file rely on their own
+        # kernels' process-wide disable state
+        support._RUNTIME_DISABLED.pop("sample_epilogue", None)
+        support._RUNTIME_DISABLED.pop("sample_epilogue_int8", None)
 
 
 def test_prefill_fault_raises(tiny):

@@ -387,6 +387,76 @@ def _self_check() -> None:
     assert held == 0, f"spec recovery leaked {held} blocks"
     print(f"compile counts OK (speculative): {rebuilt.compile_counts()}")
 
+    # the fused sampling epilogue (tick-tail fusion): on this backend
+    # the default engine resolves epilogue=fused (greedy sampler, float
+    # head, probe pass) — composition/bucket churn with the fused tail
+    # must compile NOTHING after warmup, clone_fresh must SHARE the
+    # fused step, and a runtime degrade to the XLA tail recompiles the
+    # step once for the PROCESS: a subsequent clone_fresh restart
+    # shares the degraded step and replays without a single compile
+    # (the PR 4 restart lint, extended to the epilogue)
+    from llm_np_cp_tpu.ops.pallas import support as _support
+
+    eng = ServeEngine(
+        params, cfg, sampler=Sampler(kind="greedy"), max_slots=2,
+        num_blocks=32, block_size=8, max_seq_len=64,
+        cache_dtype=jnp.float32, mixed_step="on",
+    )
+    assert eng.epilogue_impl == "fused", (
+        f"self-check expects the fused epilogue here, got "
+        f"{eng.epilogue_impl}"
+    )
+    epi_prompts = [rng.integers(1, 200, size=n) for n in (21, 5, 12)]
+    eng.warmup([int(p.size) for p in epi_prompts], max_new_tokens=6)
+    warm = dict(eng.compile_counts())
+    with CompileCounter().watch() as counter:
+        for rep in range(2):
+            for i, p in enumerate(epi_prompts):
+                eng.submit(p, 3 + i)
+            eng.run_until_complete()
+    assert counter.count == 0, (
+        f"fused-epilogue composition churn compiled: {counter.events}"
+    )
+    assert eng.compile_counts() == warm
+    assert eng.clone_fresh()._mixed_step is eng._mixed_step, (
+        "clone_fresh did not share the fused-epilogue mixed step"
+    )
+    try:
+        assert eng._degrade_mixed("self-check: forced epilogue degrade")
+        assert eng.epilogue_impl == "xla"
+        with CompileCounter().watch() as counter:
+            for p in epi_prompts:
+                eng.submit(p, 4)
+            eng.run_until_complete()
+        degraded_warm = dict(eng.compile_counts())
+        # the degrade-to-XLA retry discipline: a rebuilt engine in the
+        # SAME (degraded) process shares the XLA-tail step — recovery
+        # replay after the degrade compiles nothing
+        live = [eng.submit(p, 5) for p in epi_prompts]
+        eng.step()
+        rebuilt_epi = eng.clone_fresh()
+        assert rebuilt_epi.epilogue_impl == "xla"  # ledger is process-wide
+        assert rebuilt_epi._mixed_step is eng._mixed_step, (
+            "degraded clone_fresh did not share the XLA-tail step"
+        )
+        with CompileCounter().watch() as counter:
+            for r in live:
+                rebuilt_epi.recover(
+                    r.prompt, r.max_new_tokens, request_id=r.req_id,
+                    seed=r.seed, generated=list(r.generated),
+                )
+            rebuilt_epi.run_until_complete()
+        assert counter.count == 0, (
+            f"post-degrade restart + replay compiled: {counter.events}"
+        )
+        assert rebuilt_epi.compile_counts() == degraded_warm
+    finally:
+        # the degrade ledger is process-wide by design; the remaining
+        # sections need their kernels back
+        _support._RUNTIME_DISABLED.clear()
+    print(f"compile counts OK (fused epilogue): {warm} fused / "
+          f"{degraded_warm} degraded")
+
     # the MESH-sharded engine (ServeEngine mesh_plan): the static-shape
     # contract extends to placement — params TP-sharded, pool slabs
     # kv-head-partitioned, per-tick operands committed replicated — so

@@ -28,6 +28,15 @@ Within that scope:
   ``self._decode_step``, ``self._mixed_step``, ``self._prefill_step``,
   ``self._sample_first``, ``self._scatter_prefill``,
   ``self._gather_prefix``).  Host-side numpy packing stays legal.
+
+ONE-FETCH TIGHTENING (the tick-tail fusion contract): the exempt
+``host_sync``/``deliver`` spans are no longer a free-fire zone — the
+step returns ONE packed int32 sync array (token, finished, watermark,
+accept), so a tick method gets exactly ONE device sync across its
+exempt spans (the designated packed fetch).  Any second sync there —
+the scattered ``np.asarray`` sites this rule's tightening retired —
+bites with its own message.  Reads of the ALREADY-FETCHED host array
+(``int(out_host[...])``) are host-side and stay legal.
 """
 
 from __future__ import annotations
@@ -186,12 +195,16 @@ class _Rule:
         for fname in list(ticks) + sorted(reach):
             fn = methods[fname]
             device = _device_names(fn)
-            for node in walk_within(fn):
-                if not isinstance(node, ast.Call):
-                    continue
+            calls = sorted(
+                (n for n in walk_within(fn) if isinstance(n, ast.Call)),
+                key=lambda n: (n.lineno, n.col_offset),
+            )
+            # the ONE designated packed fetch per tick method: the
+            # first sync inside the exempt spans is the contract; every
+            # further sync there bites (the scattered-asarray class)
+            fetch_seen = False
+            for node in calls:
                 line = node.lineno
-                if fname in ticks and in_exempt(fname, line):
-                    continue
                 chain = call_name(node)
                 msg = None
                 if chain and chain[-1] == "item" and len(chain) > 1:
@@ -208,16 +221,33 @@ class _Rule:
                             f"({', '.join(sorted(device & {n.id for n in ast.walk(node.args[0]) if isinstance(n, ast.Name)}))}) "
                             "syncs device→host"
                         )
-                if msg:
+                if msg is None:
+                    continue
+                if fname in ticks and in_exempt(fname, line):
+                    if not fetch_seen:
+                        fetch_seen = True  # the designated packed fetch
+                        continue
                     out.append(Finding(
                         rule=self.id, path=sf.rel, line=line,
                         message=(
-                            f"{msg} inside tick path {fname}() outside "
-                            "the designated host_sync/deliver phase — "
-                            "move it into host_sync, or batch it with "
-                            "the tick's one fetch"
+                            f"{msg} inside {fname}()'s host_sync/"
+                            "deliver phases, AFTER the tick's "
+                            "designated fetch — the one-fetch contract "
+                            "packs everything the host needs into ONE "
+                            "int32 transfer; fold this into the packed "
+                            "sync array instead"
                         ),
                     ))
+                    continue
+                out.append(Finding(
+                    rule=self.id, path=sf.rel, line=line,
+                    message=(
+                        f"{msg} inside tick path {fname}() outside "
+                        "the designated host_sync/deliver phase — "
+                        "move it into host_sync, or batch it with "
+                        "the tick's one fetch"
+                    ),
+                ))
 
 
 RULE = _Rule()

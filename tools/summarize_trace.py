@@ -195,11 +195,16 @@ def mixed_utilization(events: list[dict]) -> dict[str, float] | None:
     drafted tokens riding the one dispatch; accept rate = how many paid
     off; emitted decode tokens = decode_tokens + spec_accept_tokens).
     None when no tick carries the args (a phase-split trace)."""
-    ticks = [e.get("args") or {} for e in events
-             if e.get("ph") == "X" and e.get("cat") == "tick"]
-    ticks = [a for a in ticks if "prefill_tokens" in a]
-    if not ticks:
+    pairs = [
+        (e.get("args") or {}, float(e.get("dur", 0.0)))
+        for e in events
+        if e.get("ph") == "X" and e.get("cat") == "tick"
+    ]
+    pairs = [(a, d) for a, d in pairs if "prefill_tokens" in a]
+    if not pairs:
         return None
+    ticks = [a for a, _ in pairs]
+    durs = [d for _, d in pairs]
     pre = sum(a["prefill_tokens"] for a in ticks)
     dec = sum(a["decode_tokens"] for a in ticks)
     total = pre + dec
@@ -222,6 +227,24 @@ def mixed_utilization(events: list[dict]) -> dict[str, float] | None:
         # per-sweep view here (the exact per-round histogram lives on
         # /metrics)
         out["spec_accept_per_tick"] = accepted / len(spec_ticks)
+    # host_sync column (the tick-tail fusion before/after instrument):
+    # per-tick host_sync wall + its share of the tick, readable from a
+    # trace alone — plus the one-fetch contract's transfer count
+    hs_pairs = [
+        (a["host_sync_us"], d) for a, d in zip(ticks, durs)
+        if "host_sync_us" in a
+    ]
+    if hs_pairs:
+        hs = [h for h, _ in hs_pairs]
+        tick_total = sum(d for _, d in hs_pairs)
+        out["host_sync_us_mean"] = sum(hs) / len(hs)
+        out["host_sync_us_p99"] = _pct(hs, 99.0)
+        out["host_sync_share"] = (
+            sum(hs) / tick_total if tick_total else 0.0
+        )
+        fetches = [a["host_fetches"] for a in ticks if "host_fetches" in a]
+        if fetches:
+            out["host_fetches_max"] = max(fetches)
     return out
 
 
@@ -335,6 +358,15 @@ def format_summary(events: list[dict], top: int = 5) -> str:
                 f"{util['spec_accept_tokens']} accepted verify tokens "
                 f"({util['spec_accept_rate']:.1%} accept rate, "
                 f"+{util['spec_accept_per_tick']:.2f} free tok/tick)"
+            )
+        if "host_sync_us_mean" in util:
+            lines.append(
+                f"host_sync: mean {util['host_sync_us_mean']:.1f}us  "
+                f"p99 {util['host_sync_us_p99']:.1f}us  "
+                f"({util['host_sync_share']:.1%} of tick"
+                + (f", <= {util['host_fetches_max']} fetch/tick"
+                   if "host_fetches_max" in util else "")
+                + ")"
             )
     roof = roofline(events)
     if roof is not None:
