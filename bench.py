@@ -312,6 +312,32 @@ SERVE_ROLLING_CONFIGS = {
                                 roll_after_ticks=3),
 }
 
+# Tiered KV prefix cache (serve/host_tier.py): ONE shared-prompt
+# Poisson trace whose prefix WORKING SET is ~4x the pool's block
+# capacity (distinct prompts cycled round-robin, so every repeat
+# arrives after its prefix blocks were LRU-reclaimed), replayed twice
+# on identical arrivals — tier off (reclaim drops, repeats re-prefill)
+# vs tier on (reclaim spills to host RAM, repeats restore via async
+# device_put above the measured breakeven).  Observables: prefix
+# hit-rate (strictly higher tier-on), prefill tokens dispatched
+# (strictly fewer tier-on — the restored bytes are prefill the fleet
+# did not redo), restore-latency p99, p99 TTFT, tok/s, TOKEN PARITY
+# (restored K/V is bit-identical to recompute), and
+# compiles_added_by_tier == 0 (restores land as ordinary pool blocks
+# through one warmed program).  num_blocks deliberately OVERRIDES the
+# worst-case sizing: capacity pressure is the whole point.
+SERVE_TIER_CONFIGS = {
+    "serve_prefix_tiered": dict(model="llama1b", requests=48, rate=16.0,
+                                prompt_len=512, max_tokens=64, slots=8,
+                                block_size=128, distinct_prompts=24,
+                                num_blocks=14, tier_gb=4.0),
+    "smoke_serve_prefix_tiered": dict(model="tiny", requests=16,
+                                      rate=50.0, prompt_len=24,
+                                      max_tokens=6, slots=2,
+                                      block_size=8, distinct_prompts=8,
+                                      num_blocks=12, tier_gb=1.0),
+}
+
 SPEC_CONFIGS = {
     # batched self-speculation: bf16 target + int8 self-draft, γ=4
     "int8_spec_bs8": dict(model="llama1b", batch=8, prompt_len=128,
@@ -347,6 +373,7 @@ PRIORITY = [
     "ragged_bs8_fdec",
     "serve_poisson_bs8",  # continuous-batching serving engine (serve/)
     "serve_prefix_shared",  # prefix-cache reuse + gather-vs-paged decode
+    "serve_prefix_tiered",  # host-RAM KV tier: spill/restore vs drop/recompute
     "serve_mixed_poisson",  # unified ragged tick vs phase-split head-to-head
     "serve_spec_poisson",  # draft-then-verify vs plain on identical arrivals
     "serve_http_poisson",  # HTTP front-end overhead vs direct engine calls
@@ -386,7 +413,7 @@ assert set(PRIORITY) == {
     + list(SERVE_HTTP_CONFIGS) + list(SERVE_CHAOS_CONFIGS)
     + list(SERVE_MIXED_CONFIGS) + list(SERVE_SPEC_CONFIGS)
     + list(SERVE_SHARDED_CONFIGS) + list(SERVE_RESTART_CONFIGS)
-    + list(SERVE_ROLLING_CONFIGS)
+    + list(SERVE_ROLLING_CONFIGS) + list(SERVE_TIER_CONFIGS)
     if not n.startswith("smoke")
 } | EXTRA_CHILDREN, "PRIORITY out of sync with config dicts"
 
@@ -403,6 +430,10 @@ TIMEOUTS = {
     # (gather + paged), roughly doubling the measured span
     "serve_poisson_bs8": 850,
     "serve_prefix_shared": 850,
+    # two trace replays (tier-off + tier-on) on one param build, under
+    # DELIBERATE pool-capacity pressure (admissions serialize on
+    # blocks, so the trace span stretches well past the shared config)
+    "serve_prefix_tiered": 1100,
     # two realtime replays of the trace (direct + HTTP) at wall-clock
     # arrival pacing (~2s traffic span each) on top of the serve compile
     # budget; the HTTP leg adds event-loop + SSE framing time per token
@@ -1300,6 +1331,175 @@ def run_serve_spec_config(name: str) -> dict:
         "roofline_gbps_mean": s["roofline_gbps_mean"],
         "roofline_util_mean": s["roofline_util_mean"],
         "hbm_gbps": s["hbm_gbps"],
+        "legs": per_leg,
+        "ragged_kernel_probe": ragged_err or "ok",
+    }
+
+
+def run_serve_tier_config(name: str) -> dict:
+    """Tiered KV prefix cache: the SAME capacity-stressed shared-prompt
+    trace (prefix working set ~4x pool blocks; distinct prompts cycled
+    so every repeat outlives its cached blocks) through two engines of
+    identical geometry — ``host_tier=None`` (LRU reclaim drops, every
+    repeat re-prefills) vs ``host_tier=HostTier(...)`` (reclaim spills
+    to host RAM, repeats restore above the measured breakeven).  The
+    observables are the ISSUE's acceptance targets: strictly higher
+    prefix hit-rate and strictly fewer prefill tokens dispatched on the
+    tier leg, restore-latency p99, p99 TTFT / tok/s deltas, token
+    parity, and ``compiles_added_by_tier == 0``.  Both legs carry SLO
+    trackers so ``tools/slo_gate.py`` can gate the leg summaries."""
+    import math
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    from llm_np_cp_tpu.ops.sampling import Sampler
+    from llm_np_cp_tpu.serve import ServeEngine, poisson_trace
+    from llm_np_cp_tpu.serve.host_tier import HostTier
+    from llm_np_cp_tpu.serve.slo import SLOPolicy, SLOTracker
+
+    t0 = time.perf_counter()
+    spec = SERVE_TIER_CONFIGS[name]
+    config, params = _build_model(spec["model"], tag=name, t0=t0)
+    _phase(name, "params_built", t0)
+    from llm_np_cp_tpu.ops.pallas.support import (
+        kernel_error,
+        ragged_kernel_name,
+    )
+
+    bs = spec["block_size"]
+    chunk = min(bs * 2, 256)
+    num_blocks = spec["num_blocks"]  # deliberately capacity-starved
+    max_seq_len = -(-(spec["prompt_len"] + spec["max_tokens"] + chunk)
+                    // bs) * bs
+    ragged_err = kernel_error(ragged_kernel_name(False))
+
+    # uniform full-length prompts: every distinct prompt contributes
+    # the same shareable block count, so the working-set ratio is exact
+    rng = np.random.default_rng(29)
+    trace = poisson_trace(
+        rng, spec["requests"], rate_rps=spec["rate"],
+        prompt_len_range=(spec["prompt_len"], spec["prompt_len"]),
+        max_new_tokens=spec["max_tokens"], vocab_size=config.vocab_size,
+        seed_base=29, distinct_prompts=spec["distinct_prompts"],
+    )
+    unit = math.lcm(bs, chunk) // bs
+    w = -(-spec["prompt_len"] // chunk) * chunk
+    keys_per_prompt = ((w - chunk) // (unit * bs)) * unit
+    working_set = spec["distinct_prompts"] * keys_per_prompt
+    _phase(name, "trace_built", t0, working_set_blocks=working_set,
+           pool_capacity=num_blocks - 1)
+
+    per_leg: dict = {}
+    tokens_by_leg: dict = {}
+    for leg in ("tier_off", "tier_on"):
+        tier = HostTier(int(spec["tier_gb"] * 2**30)) \
+            if leg == "tier_on" else None
+        engine = ServeEngine(
+            params, config,
+            sampler=Sampler(kind="greedy"),
+            max_slots=spec["slots"],
+            num_blocks=num_blocks,
+            block_size=bs,
+            max_seq_len=max_seq_len,
+            prefill_chunk=chunk,
+            cache_dtype=jnp.bfloat16,
+            mixed_step="on",
+            enable_prefix_cache=True,
+            host_tier=tier,
+        )
+        engine.warmup([int(t["prompt"].size) for t in trace],
+                      max_new_tokens=spec["max_tokens"])
+        warm_compiles = dict(engine.compile_counts())
+        engine.metrics.slo = SLOTracker(
+            SLOPolicy(ttft_s=5.0, tpot_s=1.0, target=0.99),
+            clock=engine.clock,
+        )
+        engine.n_dispatches = 0  # count the measured span only
+        _phase(name, f"warmed_{leg}", t0)
+        snap = engine.replay_trace(trace)
+        if tier is not None:
+            tier.drain()
+        _phase(name, f"trace_drained_{leg}", t0, ticks=snap["ticks"])
+        tokens_by_leg[leg] = {
+            r.req_id: list(r.generated)
+            for r in engine.scheduler.finished
+        }
+        counts = engine.compile_counts()
+        per_leg[leg] = {
+            "ok": snap["finished"] == spec["requests"],
+            "throughput_tok_s": round(snap["throughput_tok_s"], 1),
+            "ttft_s_p50": round(snap.get("ttft_s_p50", float("nan")), 4),
+            "ttft_s_p99": round(snap.get("ttft_s_p99", float("nan")), 4),
+            "ticks": snap["ticks"],
+            "preemptions": snap["preemptions"],
+            "prefix_hit_rate": round(snap.get("prefix_hit_rate", 0.0), 4),
+            "prefix_blocks_hit": snap.get("prefix_blocks_hit", 0),
+            "prefix_evicted_blocks": snap.get("prefix_evicted_blocks", 0),
+            "mixed_prefill_tokens": snap["mixed_prefill_tokens"],
+            "goodput_tok_s": round(snap.get("goodput_tok_s", 0.0), 1),
+            "slo_attainment": snap.get("slo_attainment"),
+            "compile_counts": counts,
+            "compiles_added_by_trace": (
+                counts.get("mixed_step", 0)
+                - warm_compiles.get("mixed_step", 0)
+            ),
+        }
+        if tier is not None:
+            st = tier.stats()
+            per_leg[leg].update({
+                "tier_spilled_blocks": st["spilled_blocks"],
+                "tier_restored_blocks": st["restored_blocks"],
+                "tier_restored_bytes": st["restored_bytes"],
+                "tier_restore_misses": st["restore_misses"],
+                "tier_skipped_blocks": st["skipped_blocks"],
+                "tier_restore_s_p99": round(
+                    snap.get("tier_restore_s_p99", 0.0), 6),
+                "tier_breakeven_ratio": round(
+                    snap.get("tier_breakeven_ratio", 0.0), 3),
+                "tier_restore_gbps": round(st["restore_gbps"], 3),
+            })
+            tier.close()
+        del engine
+    parity = tokens_by_leg["tier_off"] == tokens_by_leg["tier_on"]
+    off, on = per_leg["tier_off"], per_leg["tier_on"]
+    hit_win = on["prefix_hit_rate"] > off["prefix_hit_rate"]
+    prefill_win = (on["mixed_prefill_tokens"]
+                   < off["mixed_prefill_tokens"])
+    return {
+        "config": name,
+        "ok": (all(r["ok"] for r in per_leg.values()) and parity
+               and hit_win and prefill_win
+               and on["tier_restored_blocks"] > 0
+               and on["compiles_added_by_trace"] == 0),
+        "requests": spec["requests"],
+        "rate_rps": spec["rate"],
+        "slots": spec["slots"],
+        "pool_blocks": num_blocks,
+        "block_size": bs,
+        "distinct_prompts": spec["distinct_prompts"],
+        # the capacity stress in one number: shareable prefix blocks
+        # the trace's working set needs over the pool's total blocks
+        "working_set_over_capacity": round(
+            working_set / max(num_blocks - 1, 1), 2),
+        "token_parity_tier_vs_off": parity,
+        # headline: what the host tier buys on identical arrivals
+        "prefix_hit_rate": on["prefix_hit_rate"],
+        "prefix_hit_rate_off": off["prefix_hit_rate"],
+        "hit_rate_win": hit_win,
+        "prefill_tokens": on["mixed_prefill_tokens"],
+        "prefill_tokens_off": off["mixed_prefill_tokens"],
+        "prefill_tokens_saved": (off["mixed_prefill_tokens"]
+                                 - on["mixed_prefill_tokens"]),
+        "restored_blocks": on["tier_restored_blocks"],
+        "restored_bytes": on["tier_restored_bytes"],
+        "restore_s_p99": on["tier_restore_s_p99"],
+        "breakeven_ratio": on["tier_breakeven_ratio"],
+        "ttft_s_p99": on["ttft_s_p99"],
+        "ttft_s_p99_off": off["ttft_s_p99"],
+        "throughput_tok_s": on["throughput_tok_s"],
+        "throughput_tok_s_off": off["throughput_tok_s"],
+        "compiles_added_by_tier": on["compiles_added_by_trace"],
         "legs": per_leg,
         "ragged_kernel_probe": ragged_err or "ok",
     }
@@ -2432,6 +2632,7 @@ def run_warm() -> dict:
         and n not in SERVE_SHARDED_CONFIGS
         and n not in SERVE_RESTART_CONFIGS
         and n not in SERVE_ROLLING_CONFIGS
+        and n not in SERVE_TIER_CONFIGS
     ]
     for name in warmable[:warm_limit]:
         spec = {**DECODE_CONFIGS, **PREFILL_CONFIGS}[name]
@@ -2772,6 +2973,8 @@ def child_main(mode: str) -> None:
         out = run_serve_config(mode)
     elif mode in SERVE_MIXED_CONFIGS:
         out = run_serve_mixed_config(mode)
+    elif mode in SERVE_TIER_CONFIGS:
+        out = run_serve_tier_config(mode)
     elif mode in SERVE_SPEC_CONFIGS:
         out = run_serve_spec_config(mode)
     elif mode in SERVE_HTTP_CONFIGS:

@@ -147,6 +147,25 @@ def _add_serve_engine_flags(p: argparse.ArgumentParser,
                    "Cache entries are reclaimed LRU under pool pressure, "
                    "so give --num-blocks headroom beyond the worst-case "
                    "default for entries to survive between twin prompts")
+    p.add_argument("--kv-tier", choices=["off", "host"], default="off",
+                   help="tiered KV prefix cache (serve/host_tier.py): "
+                   "'host' spills LRU-reclaimed prefix blocks to a "
+                   "pinned host-RAM pool (keyed by the same chained "
+                   "content hash the prefix cache uses) and restores "
+                   "them at admission via async device_put staged off "
+                   "the tick thread — a capacity miss costs one "
+                   "host→device copy instead of a full re-prefill.  "
+                   "Restore-vs-recompute is a MEASURED breakeven "
+                   "(startup device_put probe + live prefill rates); "
+                   "below it the span re-prefills.  One tier is shared "
+                   "across all replicas, so drains/re-homes ship blocks "
+                   "replica-to-replica through it.  Requires "
+                   "--prefix-cache")
+    p.add_argument("--kv-host-tier-gb", type=float, default=4.0,
+                   metavar="G",
+                   help="host-RAM budget for --kv-tier host, GiB "
+                   "(LRU eviction past it; the tier is a cache, so "
+                   "dropping is always safe)")
     p.add_argument("--decode-attn", choices=["xla", "pallas"], default="xla",
                    help="attention kernel for the GATHERED decode step "
                    "(pallas is gated: it silently downgrades off-TPU); "
@@ -565,7 +584,7 @@ def _build_serve_engine(args, params, config, *, prog: str,
                         fault_injector=None, mesh_plan=None,
                         mesh_devices=None, shared_tracer=None,
                         journal=None, shared_request_log=None,
-                        quiet=False):
+                        shared_host_tier=None, quiet=False):
     """The shared engine build for both serve subcommands: validate the
     pool flags, resolve --attn-impl against the Mosaic probe (an EXPLICIT
     paged request must fail with an actionable message when the kernel
@@ -687,6 +706,30 @@ def _build_serve_engine(args, params, config, *, prog: str,
                   f"dispatches against {telemetry.hbm_gbps:g} GB/s "
                   "(achieved GB/s + MFU on /metrics, per-request cost "
                   "attribution in the request log)")
+    host_tier = shared_host_tier
+    if host_tier is None and getattr(args, "kv_tier", "off") == "host":
+        if not args.prefix_cache:
+            raise SystemExit(
+                "--kv-tier host requires --prefix-cache: the tier is "
+                "keyed by the prefix cache's chained content hashes"
+            )
+        gb = getattr(args, "kv_host_tier_gb", 4.0)
+        if gb <= 0:
+            raise SystemExit(
+                f"--kv-host-tier-gb must be > 0, got {gb:g}"
+            )
+        from llm_np_cp_tpu.serve.host_tier import HostTier
+
+        # ONE tier per process, shared by every replica (replica builds
+        # arrive with shared_host_tier already set) — that sharing IS
+        # the fleet block-shipping path: a drain/re-home spills through
+        # it and the destination replica restores from it
+        host_tier = HostTier(int(gb * 2**30))
+        if not quiet:
+            print(f"[{prog}] KV host tier ACTIVE: {gb:g} GiB host pool "
+                  "(evicted prefix blocks spill instead of dropping; "
+                  "admissions restore above the measured breakeven; "
+                  "shared fleet-wide for drain/re-home block shipping)")
     request_log = shared_request_log
     rl_path = getattr(args, "request_log", None)
     if request_log is None and rl_path:
@@ -729,6 +772,7 @@ def _build_serve_engine(args, params, config, *, prog: str,
         sentinel=sentinel,
         actions=actions,
         telemetry=telemetry,
+        host_tier=host_tier,
         spec_k=(
             getattr(args, "spec_k", 4)
             if getattr(args, "speculative_serve", False) else 0
@@ -841,6 +885,7 @@ def _run_serve_bench(argv: list[str], default_model: str) -> str:
                 fault_injector=injector, mesh_plan=plan,
                 mesh_devices=dev_slices[i], shared_tracer=engine.tracer,
                 shared_request_log=engine.request_log,
+                shared_host_tier=engine.host_tier,
                 quiet=True,
             )[0]
             for i in range(1, args.replicas)
@@ -895,7 +940,8 @@ def _run_serve_bench(argv: list[str], default_model: str) -> str:
         f"slots={args.slots}, pool={num_blocks}x{args.block_size} "
         f"({args.cache_dtype}), attn={engine.decode_attn_impl}, "
         f"tick={tick}, topo={topo}, "
-        f"prefix_cache={'on' if args.prefix_cache else 'off'}\n"
+        f"prefix_cache={'on' if args.prefix_cache else 'off'}, "
+        f"kv_tier={args.kv_tier}\n"
     )
     if replica_set is not None:
         out += (
@@ -985,7 +1031,8 @@ def _run_http_serve(argv: list[str], default_model: str) -> str:
             max_queue=args.max_queue or None, fault_injector=injector,
             mesh_plan=plan, mesh_devices=dev_slices[i],
             shared_tracer=engine.tracer, journal=journals[i],
-            shared_request_log=engine.request_log, quiet=True,
+            shared_request_log=engine.request_log,
+            shared_host_tier=engine.host_tier, quiet=True,
         )[0]
         for i in range(1, args.replicas)
     ]
@@ -1022,6 +1069,7 @@ def _run_http_serve(argv: list[str], default_model: str) -> str:
         f"attn={engine.decode_attn_impl}, "
         f"epilogue={engine.epilogue_impl}, topo={topo}, "
         f"prefix_cache={'on' if args.prefix_cache else 'off'}, "
+        f"kv_tier={args.kv_tier}, "
         f"max_queue={args.max_queue or 'unbounded'}, "
         f"supervision={'off' if not args.max_restarts else f'{args.max_restarts} restarts'}, "
         f"journal={'on' if args.journal else 'off'}"

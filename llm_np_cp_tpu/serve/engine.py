@@ -289,6 +289,7 @@ class ServeEngine:
         actions: Any = None,
         telemetry: Any = None,
         weights_version: int = 0,
+        host_tier: Any = None,
         spec_k: int = 0,
         spec_ngram: int = 3,
         spec_min_accept: float = 0.1,
@@ -330,6 +331,11 @@ class ServeEngine:
                 "speculative serving (spec_k > 0) rides the unified "
                 "tick's batched verifier; it cannot run with "
                 "mixed_step='off'"
+            )
+        if host_tier is not None and not enable_prefix_cache:
+            raise ValueError(
+                "host_tier requires enable_prefix_cache=True: the tier "
+                "is keyed by the prefix cache's chained content hashes"
             )
         from llm_np_cp_tpu.ops.pallas.support import (
             gate_attn_impl,
@@ -561,6 +567,57 @@ class ServeEngine:
             max_queue=max_queue,
         )
         self.metrics = ServeMetrics(clock=clock)
+        # -- host-RAM KV block tier (serve/host_tier.HostTier): spilled
+        # prefix blocks keyed by the SAME chained content hash the
+        # prefix cache uses, restored at admission as ordinary claimed
+        # pool blocks.  None = every hook is a single is-None check
+        # (tools/lint R4 `host_tier`), zero dispatches, zero recompiles.
+        self.host_tier = host_tier
+        # bytes one pool block holds across all layers (K+V + int8
+        # scale pages) — the unit every tier ledger counts in
+        self._block_nbytes = int(sum(
+            a.nbytes // a.shape[1] for a in self.pool.pages
+            if a is not None
+        ))
+        # per-tick tier observables (engine-thread-owned, reset at tick
+        # start, reported in the tick trace args when the tier is on)
+        self._tier_spill_bytes = 0
+        self._tier_restore_bytes = 0
+        self._tier_restore_us = 0.0
+        if self.pool.prefix_cache is not None:
+            # LRU reclaim is no longer silent: the callback counts the
+            # eviction (llm_serve_prefix_evicted_total), traces it, and
+            # — with the tier attached — spills the block instead of
+            # just dropping it
+            self.pool.prefix_cache.on_reclaim = self._on_prefix_reclaim
+        if host_tier is not None:
+            self._restore_block: Callable | None = (
+                self._make_restore_block()
+            )
+            self._slice_block: Callable | None = self._make_slice_block()
+            # startup breakeven measurements: host→device bandwidth
+            # from a block-sized device_put probe; the recompute side
+            # seeds from the analytic telemetry model when attached and
+            # is refined by measured prefill rates every dispatching
+            # tick (HostTier.note_prefill_rate)
+            shape = self.pool.pages.k.shape
+            blk_shape = (shape[0],) + shape[2:]
+            probes = [(blk_shape, self.cache_dtype)] * 2
+            if self.pool.pages.quantized:
+                probes += [(blk_shape[:-1], jnp.float32)] * 2
+            host_tier.ensure_probe(probes)
+            if telemetry is not None:
+                w = telemetry.weight_bytes(self.prefill_chunk, 1)
+                host_tier.note_prefill_rate(
+                    self.prefill_chunk / (w / (telemetry.hbm_gbps * 1e9))
+                )
+            self.metrics.on_tier_gauge(
+                resident_bytes=host_tier.resident_bytes,
+                breakeven=host_tier.breakeven_ratio(self.block_size),
+            )
+        else:
+            self._restore_block = None
+            self._slice_block = None
         self._next_id = 0
         self._detok: dict[int, IncrementalDetok] = {}
         # live (queued or running) requests by id — the abort/deadline
@@ -798,10 +855,22 @@ class ServeEngine:
         shareable span is capped at ``width - prefill_chunk``: the LAST
         chunk always re-prefills because the first token's logits come
         out of it, and the cap also guarantees decode writes land
-        strictly past every shared block."""
+        strictly past every shared block.
+
+        With the host tier attached, keys the device cache misses are
+        looked up host-side as well: a hit above the measured
+        restore-vs-recompute breakeven allocates ordinary pool blocks
+        for the span NOW and stages the restore after admission (the
+        plan only DECIDES — no restore job exists until the admission
+        sticks, so a backed-off plan frees the blocks with nothing in
+        flight to write into them).  Below breakeven the span
+        re-prefills (counted)."""
         w = self._prefill_width(req)
         total = self.pool.blocks_for(w)
         cache = self.pool.prefix_cache
+        # a backed-off admission freed its planned restore blocks; the
+        # stale plan must not survive into this attempt
+        req.extra.pop("tier_restore", None)
         if cache is None:
             return [], total
         unit = self._share_unit
@@ -823,7 +892,73 @@ class ServeEngine:
         # to share-unit multiples before claiming
         n_shared = (len(cache.match(keys)) // unit) * unit
         shared = cache.claim(keys[:n_shared]) if n_shared else []
-        return shared, total - len(shared)
+        restore_ids: list[int] = []
+        if self.host_tier is not None and n_shared < len(keys):
+            # combined coverage walk: LRU reclaim evicts a chain entry
+            # at a time, so a prefix routinely ends up SPLIT — some
+            # keys spilled host-side, some still registered device-side
+            # (in either interleaving).  Each covered position is
+            # either a host hit (restore into a fresh block) or a
+            # device hit (claim in place); the walk stops at the first
+            # key neither side holds, and the covered span truncates to
+            # whole share units like the device match above.
+            span: list[tuple[bytes, int | None]] = []
+            for key in keys[n_shared:]:
+                # device first: a dual-resident key (spilled copy still
+                # host-side AND re-registered device-side — routine
+                # after ship-spills and evict-restore cycles) claims in
+                # place for free instead of paying a block alloc + a
+                # host→device copy
+                dev = cache.match([key])
+                if dev:
+                    span.append((key, dev[0]))
+                    continue
+                if self.host_tier.contains(key):
+                    span.append((key, None))
+                    continue
+                break
+            span = span[: (len(span) // unit) * unit]
+            n_host = sum(1 for _, b in span if b is None)
+            if n_host and self.host_tier.should_restore(
+                n_host, self.block_size
+            ):
+                # claim the span's device entries FIRST: their increfs
+                # pin them against the LRU reclaim the restore-target
+                # allocs below may trigger (an evicted-then-reused id
+                # would corrupt the span)
+                for key, dev_blk in span:
+                    if dev_blk is not None:
+                        cache.claim([key])
+                plan: list[tuple[bytes, int, bool]] = []
+                ordered: list[int] = []
+                complete = True
+                for key, dev_blk in span:
+                    if dev_blk is not None:
+                        ordered.append(dev_blk)
+                        plan.append((key, dev_blk, False))
+                        continue
+                    ids = self.pool.alloc(1)
+                    if ids is None:
+                        complete = False
+                        break
+                    ordered.append(ids[0])
+                    plan.append((key, ids[0], True))
+                if complete:
+                    restore_ids = ordered
+                    req.extra["tier_restore"] = plan
+                else:
+                    # roll the partial span back: decref the claimed
+                    # device entries, free the allocated targets —
+                    # nothing was enqueued, so nothing dangles
+                    self.pool.free(ordered)
+                    for key, dev_blk in span[len(ordered):]:
+                        if dev_blk is not None:
+                            self.pool.free([dev_blk])
+            elif n_host:
+                # measured breakeven says re-prefilling is cheaper than
+                # restoring this span — fall back, visibly
+                self.host_tier.note_skip(n_host)
+        return shared + restore_ids, total - len(shared) - len(restore_ids)
 
     def compile_counts(self) -> dict[str, int]:
         """Compiled-program count per jitted step (the static-shape
@@ -841,14 +976,22 @@ class ServeEngine:
             return int(get()) if get is not None else -1
 
         if self.mixed:
-            return {"mixed_step": size(self._mixed_step)}
-        return {
-            "prefill_step": size(self._prefill_step),
-            "decode_step": size(self._decode_step),
-            "sample_first": size(self._sample_first),
-            "scatter_prefill": size(self._scatter_prefill),
-            "gather_prefix": size(self._gather_prefix),
-        }
+            out = {"mixed_step": size(self._mixed_step)}
+        else:
+            out = {
+                "prefill_step": size(self._prefill_step),
+                "decode_step": size(self._decode_step),
+                "sample_first": size(self._sample_first),
+                "scatter_prefill": size(self._scatter_prefill),
+                "gather_prefix": size(self._gather_prefix),
+            }
+        if self._restore_block is not None:
+            # the host tier's two programs: block id is traced and the
+            # staged/sliced layout fixed, so each must stay at ONE
+            # compile however many blocks spill or restore
+            out["restore_block"] = size(self._restore_block)
+            out["slice_block"] = size(self._slice_block)
+        return out
 
     # ------------------------------------------------------------------
     # Jitted step builders
@@ -949,6 +1092,254 @@ class ServeEngine:
             )
 
         return gather_prefix
+
+    def _make_restore_block(self) -> Callable:
+        """(pages, blk, k, v[, ks, vs]) → pages with one staged
+        host-tier block written at pool block ``blk`` — the landing
+        step of a restore.  ``blk`` arrives as a traced device scalar
+        and the staged arrays have the block's fixed [L, BS, K, D]
+        layout, so the program compiles ONCE for the process however
+        many blocks restore (the tier's zero-new-recompiles contract,
+        compile_counter tiered section)."""
+        quantized = self.cache_dtype == jnp.int8
+        constrain_pages = self._constrain_pages
+
+        if quantized:
+            @partial(jax.jit, donate_argnums=(0,))
+            def restore_block(pages: PagedKV, blk: jnp.ndarray,
+                              k: jnp.ndarray, v: jnp.ndarray,
+                              ks: jnp.ndarray, vs: jnp.ndarray):
+                new = PagedKV(
+                    k=pages.k.at[:, blk].set(k),
+                    v=pages.v.at[:, blk].set(v),
+                    k_scale=pages.k_scale.at[:, blk].set(ks),
+                    v_scale=pages.v_scale.at[:, blk].set(vs),
+                )
+                return constrain_pages(new)
+        else:
+            @partial(jax.jit, donate_argnums=(0,))
+            def restore_block(pages: PagedKV, blk: jnp.ndarray,
+                              k: jnp.ndarray, v: jnp.ndarray):
+                new = PagedKV(
+                    k=pages.k.at[:, blk].set(k),
+                    v=pages.v.at[:, blk].set(v),
+                )
+                return constrain_pages(new)
+        return restore_block
+
+    def _make_slice_block(self) -> Callable:
+        """(pages, blk) → one block's per-layer K/V (+ scale pages) as
+        standalone device arrays — the spill path's read.  The block id
+        is a TRACED scalar: an eager ``pages.k[:, blk]`` would bake
+        each Python-int index into its jaxpr and compile once per
+        distinct block id as spills churn (caught by the
+        compile-counter tiered section); this program compiles once,
+        full stop.  NOT donated — the pool keeps its pages; the slices
+        are the copies the tier's writer thread syncs to host."""
+        quantized = self.cache_dtype == jnp.int8
+
+        @jax.jit
+        def slice_block(pages: PagedKV, blk: jnp.ndarray):
+            def take(a):
+                return lax.dynamic_index_in_dim(a, blk, axis=1,
+                                                keepdims=False)
+
+            out = (take(pages.k), take(pages.v))
+            if quantized:
+                out += (take(pages.k_scale), take(pages.v_scale))
+            return out
+
+        return slice_block
+
+    # ------------------------------------------------------------------
+    # Host-RAM KV tier (serve/host_tier.py)
+    # ------------------------------------------------------------------
+    def _on_prefix_reclaim(self, key: bytes, blk: int) -> None:
+        """One prefix-cache entry is about to be LRU-reclaimed (its
+        block returns to the free list).  Always counted and traced —
+        reclaim used to be silent, so drop-vs-spill behavior was
+        invisible on the scrape — and, with the host tier attached,
+        the block's K/V is sliced for the writer thread BEFORE the id
+        frees: the eager per-block slice is an async device op ordered
+        ahead of any later overwrite, so the spill copy is race-free by
+        dispatch order and the tick thread never blocks on it."""
+        nbytes = self._block_nbytes
+        spilled = False
+        if self.host_tier is not None:
+            # snapshot the pages: a supervisor rebuild yanks the dead
+            # engine's slabs from ITS thread, and a zombie tick racing
+            # that yank must degrade to plain drop, not crash inside
+            # PrefixCache.release with the entry half-reclaimed
+            pages = self.pool.pages
+            if pages is not None:
+                spilled = True
+                try:
+                    arrs = self._slice_block(
+                        pages, self._put(np.int32(blk))
+                    )
+                except Exception:  # noqa: BLE001 — dead-pool slice = drop
+                    spilled = False
+                else:
+                    # the tier dedupes resident AND queued keys; the
+                    # LEDGERS count only blocks it actually accepted —
+                    # a re-eviction or a ship-spill race moves no bytes
+                    # and must not inflate the spill counters past the
+                    # tier's own accounting
+                    if self.host_tier.enqueue_spill(key, *arrs):
+                        self._tier_spill_bytes += nbytes
+                        self.metrics.on_tier_spill(blocks=1,
+                                                   nbytes=nbytes)
+        self.metrics.on_prefix_evicted(blocks=1, nbytes=nbytes)
+        if self.tracer is not None:
+            self.tracer.instant("prefix-evict", cat="kv_tier", args={
+                "blocks": 1, "bytes": nbytes, "spilled": spilled,
+            })
+
+    def _enqueue_tier_restores(self, req: Request) -> None:
+        """Stage the admission plan's host-tier hits: one writer-thread
+        ``jax.device_put`` job per block (replicated under a mesh so
+        the restore write's in-avals stay placement-stable).  Runs only
+        AFTER the admission stuck — the planned blocks are now owned by
+        ``req``, so a job can never target a free-listed id."""
+        plan = req.extra.get("tier_restore")
+        if not plan or self.host_tier is None:
+            return
+        req.extra["tier_tickets"] = [
+            self.host_tier.enqueue_restore(key, blk, self._rep_sharding)
+            for key, blk, is_restore in plan if is_restore
+        ]
+
+    def _apply_tier_restores(self, reqs: list[Request]) -> None:
+        """Land staged restores as ordinary pool blocks BEFORE the
+        covering dispatch (the planner pre-covered them, so they must
+        hold real K/V by then; ``host_sync`` never waits on a tier
+        transfer).  A miss — the host entry raced a capacity eviction,
+        or staging failed — un-covers the tail of the span: those
+        blocks stay allocated and ordinary prefill writes them, so the
+        stream is correct either way, just slower.  Successful spans
+        register in the device prefix cache immediately: they ARE valid
+        registered prefix blocks again, so LATER admissions hit them
+        device-side.  (Siblings admitted in the SAME admit() batch all
+        planned before any registration landed, so each restores its
+        own copy — wasteful for one batch but correct; deduping at plan
+        time would make a sibling depend on a peer's not-yet-landed
+        restore, whose failure path re-writes the block inside the very
+        dispatch the sibling attends it in.)"""
+        if self.host_tier is None:
+            return
+        for req in reqs:
+            plan = req.extra.pop("tier_restore", None)
+            tickets = req.extra.pop("tier_tickets", None)
+            if not plan or tickets is None:
+                continue
+            results = iter(self.host_tier.take_restored(tickets))
+            n_dev = req.n_shared_blocks - len(plan)
+            quantized = self.cache_dtype == jnp.int8
+            ok = 0
+            n_restored = 0
+            lat = 0.0
+            pages = self.pool.pages
+            for key, blk, is_restore in plan:
+                if not is_restore:
+                    ok += 1  # device-claimed in place: already valid
+                    continue
+                res = next(results)
+                if res is None:
+                    break  # coverage is prefix-contiguous: stop here
+                _, staged, dt = res
+                args = (staged.k, staged.v)
+                if quantized:
+                    args += (staged.k_scale, staged.v_scale)
+                self.n_dispatches += 1
+                pages = self._restore_block(
+                    pages, self._put(np.int32(blk)), *args
+                )
+                ok += 1
+                n_restored += 1
+                lat = max(lat, dt)
+            self.pool.pages = pages
+            unit = self._share_unit
+            ok = (ok // unit) * unit  # coverage in whole share units
+            if ok < len(plan):
+                # re-prefill the un-covered tail: shrink the covered
+                # span; the tail blocks stay in req.block_ids and the
+                # prefill writes them — a device-claimed block rounded
+                # out of the span is rewritten with BIT-IDENTICAL
+                # content (a slot's K/V depends only on its token and
+                # position), so sharers are unaffected
+                req.n_shared_blocks = n_dev + ok
+                req.prefill_done = min(
+                    req.prefill_done,
+                    max(req.n_shared_blocks * self.block_size - req.pad,
+                        0),
+                )
+            pc = self.pool.prefix_cache
+            for key, blk, is_restore in plan[:ok]:
+                # restored blocks ARE valid registered prefix blocks
+                # again — register immediately so a same-tick sibling
+                # admission hits them device-side (device-claimed
+                # entries are registered already; register only
+                # LRU-touches them)
+                if is_restore and pc is not None:
+                    pc.register([key], [blk])
+            if n_restored:
+                nbytes = n_restored * self._block_nbytes
+                self._tier_restore_bytes += nbytes
+                self._tier_restore_us += lat * 1e6
+                self.metrics.on_tier_restore(
+                    blocks=n_restored, nbytes=nbytes, latency_s=lat,
+                )
+                if self.tracer is not None:
+                    self.tracer.request_instant(
+                        req.req_id, "kv-restore", args=self._targs(
+                            req, blocks=n_restored, bytes=nbytes,
+                            restore_us=round(lat * 1e6, 1),
+                        ))
+
+    def spill_prefix_blocks(self, keys: list | None = None) -> int:
+        """Ship registered prefix blocks into the host tier WITHOUT
+        dropping them — the fleet's block-shipping primitive: a drain/
+        re-home (or a router spill verdict) copies the source replica's
+        prefix K/V host-side so the DESTINATION replica restores the
+        prefix instead of re-prefilling it (serve/replica.py wires
+        this into drain-to-peer, remove_replica and rolling upgrades).
+
+        ``keys=None`` ships every registered entry (a draining
+        replica's whole prefix set); passing a key chain ships just the
+        matched prefix.  Safe from any thread: a REGISTERED full prefix
+        block is never rewritten while registered (decode and suffix
+        prefill write strictly past shared blocks), so the eager
+        per-block device slices are stable whatever the tick thread is
+        doing, and the tier's writer thread pays the actual copies.
+        Returns the number of blocks enqueued."""
+        if self.host_tier is None or self.pool.prefix_cache is None \
+                or self.pool.pages is None:
+            return 0
+        if keys is None:
+            pairs = self.pool.prefix_cache.items()
+        else:
+            ids = self.pool.prefix_cache.match(list(keys))
+            pairs = list(zip(keys, ids))
+        n = 0
+        for key, blk in pairs:
+            if self.host_tier.contains(key):
+                continue  # fast path; the enqueue dedupe is authoritative
+            pages = self.pool.pages
+            if pages is None:
+                break  # supervisor yanked the slabs mid-walk
+            try:
+                arrs = self._slice_block(pages, self._put(np.int32(blk)))
+            except Exception:  # noqa: BLE001 — crashed-engine drains ship
+                # what they can: a faulted donated dispatch may have
+                # consumed the dead pool's buffers, in which case the
+                # un-shipped prefixes just re-prefill (the tier-less
+                # behavior), never break the drain itself
+                break
+            if self.host_tier.enqueue_spill(key, *arrs):
+                self.metrics.on_tier_spill(blocks=1,
+                                           nbytes=self._block_nbytes)
+                n += 1
+        return n
 
     def _make_decode_step(self, attn_impl: str) -> Callable:
         if attn_impl == "paged":
@@ -1718,6 +2109,7 @@ class ServeEngine:
                 weights_version if weights_version is not None
                 else self.weights_version
             ),
+            host_tier=self.host_tier,
             spec_k=self.spec_k,
             spec_ngram=self.spec_ngram,
             spec_min_accept=self.spec_min_accept,
@@ -1726,6 +2118,12 @@ class ServeEngine:
         eng.metrics = self.metrics
         eng.decode_degraded = self.decode_degraded
         eng._next_id = self._next_id
+        if self._restore_block is not None and eng._restore_block is not None:
+            # the tier rides the rebuild (host entries survive the
+            # crash — the zeroed pool restores instead of re-prefilling)
+            # and identical geometry means identical tier jaxprs
+            eng._restore_block = self._restore_block
+            eng._slice_block = self._slice_block
         if self.mixed:
             if (
                 eng.mixed
@@ -1769,6 +2167,10 @@ class ServeEngine:
         still once per set of identical placements, never per roll."""
         if not self._same_placement(src):
             return
+        if self._restore_block is not None \
+                and src._restore_block is not None:
+            self._restore_block = src._restore_block
+            self._slice_block = src._slice_block
         if self.mixed and src.mixed \
                 and self.ragged_attn_impl == src.ragged_attn_impl \
                 and self.epilogue_impl == src.epilogue_impl:
@@ -1989,6 +2391,11 @@ class ServeEngine:
         are never written."""
         if self.faults is not None and self.faults.trip("prefill") is not None:
             raise FaultInjected("prefill")
+        # host-tier hits land FIRST: the claimed blocks must hold real
+        # K/V before gather_prefix copies them into the temp cache (a
+        # miss un-covers the tail, which then prefills as fresh blocks)
+        self._enqueue_tier_restores(req)
+        self._apply_tier_restores([req])
         t_tel = self.clock() if self.telemetry is not None else 0.0
         content = req.effective_prompt()
         w = self._prefill_width(req)
@@ -2016,6 +2423,7 @@ class ServeEngine:
                 self._put(np.int32(req.pad)),
             )
             cache = self._repin_temp_cache(cache)
+        t_pf = self.clock() if self.host_tier is not None else 0.0
         last = None
         for off in range(shared_slots, w, self.prefill_chunk):
             end = off + self.prefill_chunk
@@ -2066,6 +2474,12 @@ class ServeEngine:
         # token inside the prefill phase (its wall time is accounted to
         # prefill_s); the unified tick retired this extra sync
         tok_host = int(np.asarray(tok)[0])
+        if self.host_tier is not None and w > shared_slots:
+            # measured prefill rate over the fresh chunks (the sync
+            # above closed the window) — the breakeven's recompute side
+            dt = self.clock() - t_pf
+            if dt > 0:
+                self.host_tier.note_prefill_rate((w - shared_slots) / dt)
         if self.telemetry is not None:
             # the chunk dispatches are per-request by construction: the
             # whole bill (weights streamed per chunk, fresh K/V written,
@@ -2114,6 +2528,10 @@ class ServeEngine:
         attached mid-tick."""
         t0 = self.tracer.now_us() if self.tracer is not None else -1.0
         fetches0 = self.n_host_fetches
+        if self.host_tier is not None:
+            self._tier_spill_bytes = 0
+            self._tier_restore_bytes = 0
+            self._tier_restore_us = 0.0
         self._sweep_deadlines()
         admitted = self.scheduler.admit()
         t1 = self.tracer.now_us() if self.tracer is not None else -1.0
@@ -2209,6 +2627,13 @@ class ServeEngine:
             # for every live request whose count advanced) — batched
             # per tick, never per token
             self.journal.end_tick(self._requests.values())
+        if self.host_tier is not None and (
+            self._tier_spill_bytes or self._tier_restore_bytes
+        ):
+            self.metrics.on_tier_gauge(
+                resident_bytes=self.host_tier.resident_bytes,
+                breakeven=self.host_tier.breakeven_ratio(self.block_size),
+            )
         self.metrics.on_tick(
             queue_depth=self.scheduler.queue_depth,
             occupancy=self.pool.occupancy,
@@ -2230,6 +2655,10 @@ class ServeEngine:
                 "host_sync_us": round(max(t5 - t4, 0.0), 1),
                 "host_fetches": self.n_host_fetches - fetches0,
             }
+            if self.host_tier is not None:
+                targs["tier_spill_bytes"] = self._tier_spill_bytes
+                targs["tier_restore_bytes"] = self._tier_restore_bytes
+                targs["tier_restore_us"] = round(self._tier_restore_us, 1)
             if tel is not None:
                 targs.update(_roofline_targs(tel))
             self.tracer.tick(t0, (
@@ -2469,11 +2898,21 @@ class ServeEngine:
         zombie-mute reason as the split tick."""
         t0 = self.tracer.now_us() if self.tracer is not None else -1.0
         fetches0 = self.n_host_fetches
+        if self.host_tier is not None:
+            self._tier_spill_bytes = 0
+            self._tier_restore_bytes = 0
+            self._tier_restore_us = 0.0
         self._sweep_deadlines()
         admitted = self.scheduler.admit()
         for req in admitted:
             if req.admit_time is None:
                 req.admit_time = self.clock()
+            # stage this admission's host-tier hits FIRST so the writer
+            # thread's device_puts overlap the rest of the admission
+            # loop; they land (_apply_tier_restores below) before any
+            # growth/eviction could free a target block and before the
+            # covering dispatch attends them
+            self._enqueue_tier_restores(req)
             self._init_mixed_prefill(req)
             if self.tracer is not None:
                 self.tracer.request_phase(
@@ -2481,6 +2920,7 @@ class ServeEngine:
                         req, shared_blocks=req.n_shared_blocks,
                         preemptions=req.n_preemptions,
                     ))
+        self._apply_tier_restores(admitted)
         t1 = self.tracer.now_us() if self.tracer is not None else -1.0
 
         self._draft_tick()
@@ -2553,6 +2993,11 @@ class ServeEngine:
                 )
                 for r, n in prefill_segs:
                     r.prefill_s += per_tok * n
+                if self.host_tier is not None and per_tok > 0:
+                    # the recompute side of the restore-vs-recompute
+                    # breakeven: a MEASURED prefill token rate, refined
+                    # every dispatching tick
+                    self.host_tier.note_prefill_rate(1.0 / per_tok)
             for r, n in prefill_segs:
                 r.prefill_done += n
                 if r.prefill_done >= r.prefill_target:
@@ -2605,6 +3050,13 @@ class ServeEngine:
             # delivered — rejected drafts never reach req.generated, so
             # they never reach the journal and replay stays exact
             self.journal.end_tick(self._requests.values())
+        if self.host_tier is not None and (
+            self._tier_spill_bytes or self._tier_restore_bytes
+        ):
+            self.metrics.on_tier_gauge(
+                resident_bytes=self.host_tier.resident_bytes,
+                breakeven=self.host_tier.breakeven_ratio(self.block_size),
+            )
         active = n_decode_tok + len(prefill_segs)
         self.metrics.on_tick(
             queue_depth=self.scheduler.queue_depth,
@@ -2640,6 +3092,12 @@ class ServeEngine:
                 # dispatch and how many paid off
                 targs["spec_draft_tokens"] = n_spec_tok
                 targs["spec_accept_tokens"] = n_spec_acc
+            if self.host_tier is not None:
+                # the tier's per-tick byte flow (what summarize_trace's
+                # kv_tier section and a Perfetto tick click read)
+                targs["tier_spill_bytes"] = self._tier_spill_bytes
+                targs["tier_restore_bytes"] = self._tier_restore_bytes
+                targs["tier_restore_us"] = round(self._tier_restore_us, 1)
             if tel is not None:
                 targs.update(_roofline_targs(tel))
             self.tracer.tick(t0, (
@@ -2896,6 +3354,10 @@ class ServeEngine:
         # telemetry too: warmup ticks are compile-only, not device work
         # worth billing or baselining
         telemetry, self.telemetry = self.telemetry, None
+        # ...and the host tier: the dummy request's blocks must not
+        # spill into (or restore from) the shared host pool, and its
+        # wall times must not seed the breakeven's prefill rate
+        host_tier, self.host_tier = self.host_tier, None
         # the SLO tracker is suspended the same way (the dummy request
         # must not count as a verdict) and survives _warmup_body's
         # metrics reset — the fresh ServeMetrics gets it back
@@ -2909,6 +3371,7 @@ class ServeEngine:
             self.journal = journal
             self.request_log = request_log
             self.telemetry = telemetry
+            self.host_tier = host_tier
             self.metrics.slo = slo_tracker
 
     def _warmup_body(self, prompt_lens: list[int],
@@ -2918,6 +3381,22 @@ class ServeEngine:
         self.submit(np.ones(min(prompt_lens), np.int32),
                     min(2, max_new_tokens))
         self.run_until_complete()
+        if self._restore_block is not None:
+            # the host tier's one landing program: warm it against the
+            # scratch block (garbage there is harmless by construction)
+            # so the first mid-traffic restore never pays a compile
+            shape = self.pool.pages.k.shape
+            blk_shape = (shape[0],) + shape[2:]
+            args = [self._put(jnp.zeros(blk_shape, self.cache_dtype))] * 2
+            if self.pool.pages.quantized:
+                args += [
+                    self._put(jnp.zeros(blk_shape[:-1], jnp.float32))
+                ] * 2
+            self.pool.pages = self._restore_block(
+                self.pool.pages, self._put(np.int32(0)), *args
+            )
+            # ...and the spill-path slicer (same traced-index contract)
+            self._slice_block(self.pool.pages, self._put(np.int32(0)))
         if self.mixed:
             # one compile per packed-width bucket — the dummy request
             # covered whichever buckets its own ticks picked; warm the

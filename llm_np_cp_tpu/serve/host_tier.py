@@ -1,0 +1,502 @@
+"""Host-RAM KV block tier: spill evicted prefix blocks, restore on hit.
+
+The paged pool's prefix cache (serve/prefix_cache.py) makes shared
+prompt blocks free to SERVE but not free to KEEP: at fleet scale the
+prefix working set dwarfs pool HBM, and LRU reclaim simply drops
+cache-only blocks — the next request with that prefix re-prefills
+through the paged pool, paying the full ragged-attention sweep for K/V
+the fleet already computed.  This module adds the tier under the pool:
+
+- **spill** — when LRU reclaim is about to drop a fully-filled prefix
+  block (``PrefixCache.on_reclaim``), the engine hands its device K/V
+  (and int8 scale pages) to the tier; the WRITER THREAD copies them to
+  host RAM, keyed by the block's existing chained content hash.  Key
+  equality stays block-key equality, so ``PrefixCache``, the
+  ``PrefixRouter`` and the journal need no new identity scheme.
+- **restore** — at admission, ``ServeEngine._prefill_plan`` consults
+  the tier AFTER the device cache; hits are staged back via
+  ``jax.device_put`` on the writer thread and land as ordinary claimed
+  pool blocks before the covering tick dispatches (the engine's
+  ``_apply_tier_restores``), so restored prefixes consume ZERO tick
+  budget exactly like device prefix hits and ``host_sync`` never waits
+  on a transfer.
+- **ship** — the fleet's drain/re-home paths (serve/replica.py) spill a
+  replica's registered prefix blocks through the SHARED process tier
+  before its prefixes re-home, so the destination replica restores them
+  instead of re-prefilling.
+
+Restore-vs-recompute is a MEASURED breakeven, not an assumption: a
+startup probe times ``jax.device_put`` of one block-sized buffer
+(``ensure_probe``) and the engine feeds a rolling measured prefill
+token rate (``note_prefill_rate``; seeded from the analytic
+TelemetryModel when attached).  ``should_restore`` compares restoring a
+span against re-prefilling it; below breakeven the plan falls back to
+re-prefill (counted, test-pinned).  ``breakeven_ratio`` > 1 means a
+restore is cheaper than recomputing the same block.
+
+THREADING (machine-checked by tools/lint R3, domain ``host_tier``):
+the writer thread exclusively owns the host block store (``_wentries``,
+``_wbytes``) — spills insert, capacity evicts LRU, restores read and
+stage.  The engine/loop side communicates through the lock-protected
+job queue (``_pending``) and completion map (``_done``); the counters
+share the same lock.  ``match``/``contains`` READ the store without the
+lock — dict lookups are GIL-atomic and a lost race just surfaces as a
+restore miss the engine already handles by re-prefilling (benign racy
+reads are the serve stack's documented pattern).
+
+ZERO-OVERHEAD WHEN OFF: nothing constructs a ``HostTier`` unless
+requested (``--kv-tier host``), and every engine hook is a single
+``is None`` check (tools/lint R4 ``host_tier`` hook).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import OrderedDict
+from typing import Any, Callable, NamedTuple
+
+import numpy as np
+
+
+class HostBlock(NamedTuple):
+    """One pool block's K/V, host-resident.  Arrays are the block's
+    device layout minus the block axis: ``[L, BS, K, D]`` (scales
+    ``[L, BS, K]`` for int8 pools, else None)."""
+
+    k: np.ndarray
+    v: np.ndarray
+    k_scale: np.ndarray | None
+    v_scale: np.ndarray | None
+
+    @property
+    def nbytes(self) -> int:
+        return sum(int(a.nbytes) for a in self if a is not None)
+
+
+class HostTier:
+    """LRU host pool of spilled KV blocks + the writer thread that
+    moves them.
+
+    ``capacity_bytes`` bounds host residency (LRU eviction past it —
+    the tier is a cache, dropping is always safe).  One instance is
+    shared per PROCESS: every replica's spills and restores go through
+    it, which is exactly what makes fleet block shipping work.
+    """
+
+    def __init__(
+        self,
+        capacity_bytes: int,
+        *,
+        clock: Callable[[], float] = time.perf_counter,
+    ) -> None:
+        if capacity_bytes <= 0:
+            raise ValueError(
+                f"capacity_bytes must be > 0, got {capacity_bytes}"
+            )
+        self.capacity_bytes = int(capacity_bytes)
+        self.clock = clock
+        # writer-thread-owned (R3 "host_tier" domain): the host block
+        # store, LRU-ordered oldest first, and its resident byte count
+        self._wentries: OrderedDict[bytes, HostBlock] = OrderedDict()
+        self._wbytes = 0
+        # shared under _lock: the job queue, the staged-restore
+        # completion map, and the counters
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._pending: list = []
+        self._done: dict[int, Any] = {}
+        # tickets whose waiter timed out: the writer drops their staged
+        # payloads instead of parking them in _done forever (the waiter
+        # already fell back to re-prefill; an orphaned entry would pin
+        # a block of device memory for the process lifetime)
+        self._abandoned: set[int] = set()
+        # keys with a spill job queued but not yet applied: the dedupe
+        # the enqueue side keys off (contains() only sees APPLIED
+        # entries, so without this a ship-spill racing an evict-spill
+        # would double-queue and double-count)
+        self._pending_spill_keys: set[bytes] = set()
+        self._stopping = False
+        self._next_ticket = 0
+        self.n_spilled = 0
+        self.spilled_bytes = 0
+        self.n_restored = 0
+        self.restored_bytes = 0
+        self.n_restore_miss = 0
+        self.n_dropped = 0
+        self.n_skipped = 0  # below-breakeven re-prefill fallbacks
+        self.restore_s: list[float] = []
+        # breakeven measurements (shared under _lock): the startup
+        # device_put probe and the engine-fed prefill-rate EWMA
+        self.restore_s_per_block: float | None = None
+        self.restore_gbps: float | None = None
+        self.prefill_tok_s: float | None = None
+        self._probed_bytes = 0
+        # test/operator override: "auto" applies the measured breakeven,
+        # "always"/"never" force the verdict (the forced-fallback test
+        # and the bench's tier-off twin use these)
+        self.policy = "auto"
+        self._thread = threading.Thread(
+            target=self._writer_loop, name="serve-kv-tier-writer",
+            daemon=True,
+        )
+        self._thread.start()
+
+    # -- lookups (engine/loop side; lock-free reads, see module doc) ---
+    def match(self, keys: list[bytes]) -> int:
+        """Longest leading run of ``keys`` host-resident right now.
+        Pure lookup — no LRU touch (the restore jobs touch); a racing
+        capacity eviction just turns into a restore miss later."""
+        n = 0
+        for key in keys:
+            if key not in self._wentries:
+                break
+            n += 1
+        return n
+
+    def contains(self, key: bytes) -> bool:
+        return key in self._wentries
+
+    @property
+    def resident_bytes(self) -> int:
+        return self._wbytes
+
+    def __len__(self) -> int:
+        return len(self._wentries)
+
+    # -- breakeven policy ----------------------------------------------
+    def ensure_probe(self, block_shapes: list[tuple[tuple[int, ...], Any]],
+                     *, device_put: Callable | None = None,
+                     reps: int = 3) -> None:
+        """Measure host→device bandwidth ONCE per tier with a
+        block-sized transfer: build zero host buffers of the pool
+        block's shapes/dtypes, time ``device_put`` + block-until-ready
+        over ``reps`` transfers, keep the median.  Engines call this at
+        build time (the probe is startup work, never tick work); later
+        engines with the same geometry skip it."""
+        import jax
+
+        put = device_put or jax.device_put
+        nbytes = 0
+        bufs = []
+        for shape, dtype in block_shapes:
+            a = np.zeros(shape, dtype=dtype)
+            bufs.append(a)
+            nbytes += a.nbytes
+        with self._lock:
+            if self.restore_s_per_block is not None \
+                    and self._probed_bytes == nbytes:
+                return
+        samples = []
+        for _ in range(max(reps, 1)):
+            t0 = self.clock()
+            staged = [put(a) for a in bufs]
+            for s in staged:
+                s.block_until_ready()
+            samples.append(self.clock() - t0)
+        med = float(np.median(samples))
+        with self._lock:
+            self.restore_s_per_block = med
+            self.restore_gbps = (
+                nbytes / med / 1e9 if med > 0 else float("inf")
+            )
+            self._probed_bytes = nbytes
+
+    def note_prefill_rate(self, tok_s: float) -> None:
+        """Feed one measured (or model-seeded) prefill token rate; the
+        EWMA is the recompute side of the breakeven."""
+        if tok_s <= 0:
+            return
+        with self._lock:
+            if self.prefill_tok_s is None:
+                self.prefill_tok_s = float(tok_s)
+            else:
+                self.prefill_tok_s += 0.2 * (tok_s - self.prefill_tok_s)
+
+    def set_measured(self, *, restore_s_per_block: float | None = None,
+                     prefill_tok_s: float | None = None) -> None:
+        """Pin the breakeven inputs directly (tests and offline
+        calibration; production uses ensure_probe/note_prefill_rate)."""
+        with self._lock:
+            if restore_s_per_block is not None:
+                self.restore_s_per_block = float(restore_s_per_block)
+            if prefill_tok_s is not None:
+                self.prefill_tok_s = float(prefill_tok_s)
+
+    def breakeven_ratio(self, block_size: int) -> float | None:
+        """(seconds to re-prefill one block) / (seconds to restore it):
+        > 1 means restoring is cheaper.  None until both sides are
+        measured — the scrape gauge reports 0 then."""
+        restore_s = self.restore_s_per_block
+        tok_s = self.prefill_tok_s
+        if not restore_s or not tok_s:
+            return None
+        return (block_size / tok_s) / restore_s
+
+    def should_restore(self, n_blocks: int, block_size: int) -> bool:
+        """The per-prefix restore-vs-recompute verdict for a span of
+        ``n_blocks`` (the span cancels out of the measured ratio; it is
+        kept in the signature because a future disk tier pays per-span
+        seek costs).  Unmeasured sides default to restore — a restore
+        is bit-identical, so the optimistic default is correctness-
+        neutral, and the probe runs at engine build anyway."""
+        if self.policy == "always":
+            return True
+        if self.policy == "never":
+            return False
+        ratio = self.breakeven_ratio(block_size)
+        return ratio is None or ratio >= 1.0
+
+    def note_skip(self, n_blocks: int) -> None:
+        """A below-breakeven host hit fell back to re-prefill."""
+        with self._lock:
+            self.n_skipped += n_blocks
+
+    # -- spill / restore (enqueue side; any thread) --------------------
+    def enqueue_spill(self, key: bytes, k: Any, v: Any,
+                      k_scale: Any = None, v_scale: Any = None) -> bool:
+        """Queue one block's device arrays for host copy.  Callers pass
+        freshly-sliced per-block device arrays (the slice is an async
+        device op ordered before any later overwrite of the pool block,
+        so the copy is race-free by dispatch order); the writer thread
+        pays the device→host sync.  Returns False — and queues nothing
+        — when the key is already resident OR already pending (a
+        ship-spill racing an evict-spill is routine), so callers' spill
+        ledgers can never run ahead of the tier's own accounting."""
+        with self._lock:
+            if self._stopping:
+                return False
+            if key in self._pending_spill_keys or key in self._wentries:
+                return False
+            self._pending_spill_keys.add(key)
+            self._pending.append(("spill", key, k, v, k_scale, v_scale))
+            self._cond.notify()
+        return True
+
+    def enqueue_restore(self, key: bytes, block_id: int,
+                        sharding: Any = None) -> int:
+        """Queue one host block for device staging; returns the ticket
+        ``take_restored`` redeems.  ``sharding`` (replicated, from the
+        claiming engine's mesh) keeps staged in-avals placement-stable
+        so the restore write never retraces."""
+        with self._lock:
+            ticket = self._next_ticket
+            self._next_ticket += 1
+            if self._stopping:
+                self._done[ticket] = None
+            else:
+                self._pending.append(
+                    ("restore", ticket, key, block_id, sharding))
+                self._cond.notify()
+        return ticket
+
+    def take_restored(self, tickets: list[int],
+                      timeout: float = 10.0) -> list[Any]:
+        """Redeem restore tickets, in order; blocks until the writer
+        has staged them all (or ``timeout``, after which missing
+        entries come back None — the caller re-prefills, the contract
+        every miss path shares).  Each result is ``(block_id, staged
+        HostBlock-of-device-arrays, stage_seconds)`` or None."""
+        deadline = self.clock() + timeout
+        out: list[Any] = []
+        with self._lock:
+            for t in tickets:
+                while t not in self._done:
+                    left = deadline - self.clock()
+                    if left <= 0 or (self._stopping
+                                     and not self._pending):
+                        break
+                    self._cond.wait(min(left, 0.5))
+                if t in self._done:
+                    out.append(self._done.pop(t))
+                else:
+                    # gave up on this ticket: mark it abandoned so the
+                    # writer drops the late payload instead of parking
+                    # staged device arrays in _done forever
+                    self._abandoned.add(t)
+                    out.append(None)
+        return out
+
+    def await_resident(self, keys: list[bytes],
+                       timeout: float = 2.0) -> bool:
+        """Wait until every key in ``keys`` is host-resident (or
+        ``timeout``) — the PER-CHAIN ship barrier: unlike ``drain``,
+        which flushes the tier's whole queue (every job paying its
+        device→host sync), this returns the moment the named chain
+        lands, however busy the shared queue is.  False on timeout —
+        the caller's admission just misses and re-prefills, the
+        fallback every tier path shares."""
+        deadline = self.clock() + timeout
+        with self._lock:
+            while True:
+                if all(k in self._wentries for k in keys):
+                    return True
+                left = deadline - self.clock()
+                if left <= 0 or self._stopping:
+                    return False
+                self._cond.wait(min(left, 0.2))
+
+    # -- control -------------------------------------------------------
+    def drain(self, timeout: float = 10.0) -> bool:
+        """Barrier: every job enqueued before this call is processed
+        (tests and the fleet drain path use it before asserting on or
+        reading tier state)."""
+        ev = threading.Event()
+        with self._lock:
+            if self._stopping and not self._thread.is_alive():
+                return True
+            self._pending.append(("flush", ev))
+            self._cond.notify()
+        return ev.wait(timeout)
+
+    def close(self, timeout: float = 10.0) -> None:
+        with self._lock:
+            if self._stopping:
+                return
+            self._stopping = True
+            self._cond.notify()
+        self._thread.join(timeout)
+
+    def stats(self) -> dict[str, Any]:
+        """Point-in-time accounting for scrapes and tests."""
+        with self._lock:
+            restore_s = list(self.restore_s)
+            out = {
+                "capacity_bytes": self.capacity_bytes,
+                "resident_bytes": self._wbytes,
+                "resident_blocks": len(self._wentries),
+                "spilled_blocks": self.n_spilled,
+                "spilled_bytes": self.spilled_bytes,
+                "restored_blocks": self.n_restored,
+                "restored_bytes": self.restored_bytes,
+                "restore_misses": self.n_restore_miss,
+                "dropped_blocks": self.n_dropped,
+                "skipped_blocks": self.n_skipped,
+                "restore_gbps": self.restore_gbps or 0.0,
+                "prefill_tok_s": self.prefill_tok_s or 0.0,
+            }
+        out["restore_s_p99"] = (
+            float(np.percentile(np.asarray(restore_s), 99))
+            if restore_s else 0.0
+        )
+        return out
+
+    # -- writer thread (R3 "host_tier" domain) -------------------------
+    def _writer_loop(self) -> None:
+        while True:
+            with self._lock:
+                while not self._pending and not self._stopping:
+                    self._cond.wait(0.5)
+                batch, self._pending = self._pending, []
+                stopping = self._stopping
+            for job in batch:
+                self._writer_job(job)
+            if stopping:
+                with self._lock:
+                    leftover, self._pending = self._pending, []
+                    # unblock any take_restored waiters: their tickets
+                    # resolve to None and the engine re-prefills
+                    for job in leftover:
+                        if job[0] == "restore":
+                            self._done[job[1]] = None
+                        elif job[0] == "flush":
+                            job[1].set()
+                    self._cond.notify_all()
+                return
+
+    def _writer_job(self, job: tuple) -> None:
+        kind = job[0]
+        if kind == "flush":
+            job[1].set()
+            return
+        if kind == "spill":
+            self._writer_spill(job)
+        else:
+            self._writer_restore(job)
+
+    def _writer_spill(self, job: tuple) -> None:
+        _, key, k, v, ks, vs = job
+        if key in self._wentries:
+            # already resident (the enqueue-side dedupe lost a race):
+            # content under one key is identical by construction, so
+            # touching the LRU slot is the whole job
+            self._wentries.move_to_end(key)
+            with self._lock:
+                self._pending_spill_keys.discard(key)
+            return
+        try:
+            blk = HostBlock(
+                k=np.asarray(k), v=np.asarray(v),
+                k_scale=np.asarray(ks) if ks is not None else None,
+                v_scale=np.asarray(vs) if vs is not None else None,
+            )
+        except Exception:  # noqa: BLE001 — a failed copy drops, never crashes
+            with self._lock:
+                self.n_dropped += 1
+                self._pending_spill_keys.discard(key)
+            return
+        self._wentries[key] = blk
+        self._wbytes += blk.nbytes
+        dropped = 0
+        while self._wbytes > self.capacity_bytes and len(self._wentries) > 1:
+            _, old = self._wentries.popitem(last=False)
+            self._wbytes -= old.nbytes
+            dropped += 1
+        with self._lock:
+            self.n_spilled += 1
+            self.spilled_bytes += blk.nbytes
+            self.n_dropped += dropped
+            self._pending_spill_keys.discard(key)
+            # wake await_resident waiters (the per-chain ship barrier)
+            self._cond.notify_all()
+
+    def _writer_restore(self, job: tuple) -> None:
+        import jax
+
+        _, ticket, key, block_id, sharding = job
+        ent = self._wentries.get(key)
+        if ent is None:
+            with self._lock:
+                self.n_restore_miss += 1
+                if ticket in self._abandoned:
+                    self._abandoned.discard(ticket)
+                else:
+                    self._done[ticket] = None
+                self._cond.notify_all()
+            return
+        self._wentries.move_to_end(key)  # a restore is an LRU touch
+        t0 = self.clock()
+        try:
+            if sharding is not None:
+                put = lambda a: jax.device_put(a, sharding)  # noqa: E731
+            else:
+                put = jax.device_put
+            staged = HostBlock(
+                k=put(ent.k), v=put(ent.v),
+                k_scale=put(ent.k_scale) if ent.k_scale is not None else None,
+                v_scale=put(ent.v_scale) if ent.v_scale is not None else None,
+            )
+            staged.k.block_until_ready()
+        except Exception:  # noqa: BLE001 — staging failure = miss, engine re-prefills
+            with self._lock:
+                self.n_restore_miss += 1
+                if ticket in self._abandoned:
+                    self._abandoned.discard(ticket)
+                else:
+                    self._done[ticket] = None
+                self._cond.notify_all()
+            return
+        dt = self.clock() - t0
+        with self._lock:
+            self.n_restored += 1
+            self.restored_bytes += ent.nbytes
+            self.restore_s.append(dt)
+            if len(self.restore_s) > 4096:
+                del self.restore_s[:2048]
+            if ticket in self._abandoned:
+                # the waiter timed out and re-prefilled: drop the late
+                # payload — nothing will ever redeem this ticket
+                self._abandoned.discard(ticket)
+            else:
+                self._done[ticket] = (block_id, staged, dt)
+            self._cond.notify_all()

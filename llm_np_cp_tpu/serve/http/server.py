@@ -858,6 +858,11 @@ class EngineRunner:
         # would corrupt the streak/burn state the rebuilt engine's
         # ticks now advance
         old.actions = None
+        # ...and the host tier: the clone shares the REAL (process-
+        # wide) tier; a zombie tick's late reclaim must not spill its
+        # yanked pool's garbage into the shared host store, nor its
+        # wall times pollute the breakeven measurements
+        old.host_tier = None
         with self._sup_lock:
             if gen != self._gen:
                 # superseded DURING the rebuild (it wedged long enough

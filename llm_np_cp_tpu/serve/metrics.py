@@ -156,6 +156,21 @@ class ServeMetrics:
         self.kv_bytes_tick: list[float] = []
         self.prefix_blocks_requested = 0
         self.prefix_blocks_hit = 0
+        # prefix-cache LRU reclaim (always counted — reclaim used to be
+        # silent, so drop-vs-spill behavior was invisible on a scrape)
+        # + the host-RAM KV tier's flow (serve/host_tier.py): spill and
+        # restore ledgers in blocks AND bytes, restore-latency samples,
+        # and the resident/breakeven gauges the engine refreshes on
+        # tier-active ticks.  Zero/absent unless a tier is attached.
+        self.prefix_evicted_blocks = 0
+        self.prefix_evicted_bytes = 0.0
+        self.tier_spilled_blocks = 0
+        self.tier_spilled_bytes = 0.0
+        self.tier_restored_blocks = 0
+        self.tier_restored_bytes = 0.0
+        self.tier_restore_s: list[float] = []
+        self.tier_resident_bytes = 0.0
+        self.tier_breakeven: float | None = None
         # unified-tick (mixed_step) utilization: how this engine's token
         # budget was actually spent — exact counters, never trimmed
         self.mixed_prefill_tokens = 0
@@ -296,6 +311,43 @@ class ServeMetrics:
             self.prefix_blocks_requested += requested
             self.prefix_blocks_hit += hits
 
+    def on_prefix_evicted(self, *, blocks: int, nbytes: int) -> None:
+        """LRU reclaim dropped ``blocks`` prefix-cache entries (their
+        K/V bytes included) — with the host tier attached the same
+        blocks ALSO count as spills; without it this is the only
+        record a prefix was recomputable work thrown away."""
+        with self._lock:
+            self.prefix_evicted_blocks += blocks
+            self.prefix_evicted_bytes += nbytes
+
+    def on_tier_spill(self, *, blocks: int, nbytes: int) -> None:
+        """``blocks`` evicted prefix blocks were handed to the host
+        tier's writer thread instead of being dropped."""
+        with self._lock:
+            self.tier_spilled_blocks += blocks
+            self.tier_spilled_bytes += nbytes
+
+    def on_tier_restore(self, *, blocks: int, nbytes: int,
+                        latency_s: float) -> None:
+        """One admission's host-tier span landed back in the pool:
+        ``blocks`` restored (``nbytes`` of K/V that did NOT re-prefill)
+        after ``latency_s`` of writer-thread staging."""
+        with self._lock:
+            self.tier_restored_blocks += blocks
+            self.tier_restored_bytes += nbytes
+            self.tier_restore_s.append(latency_s)
+            self._trim(self.tier_restore_s)
+
+    def on_tier_gauge(self, *, resident_bytes: int,
+                      breakeven: float | None) -> None:
+        """Refresh the tier's live gauges: host bytes resident and the
+        measured restore-vs-recompute breakeven ratio (>1 = restoring
+        one block is cheaper than re-prefilling it; 0 until both sides
+        are measured)."""
+        with self._lock:
+            self.tier_resident_bytes = float(resident_bytes)
+            self.tier_breakeven = breakeven
+
     def on_token(self, req: Request) -> None:
         with self._lock:
             self.total_generated += 1
@@ -380,6 +432,20 @@ class ServeMetrics:
             kvb = list(self.kv_bytes_tick)
             prefix_req = self.prefix_blocks_requested
             prefix_hit = self.prefix_blocks_hit
+            tier_restore = list(self.tier_restore_s)
+            out["prefix_evicted_blocks"] = self.prefix_evicted_blocks
+            out["prefix_evicted_bytes"] = self.prefix_evicted_bytes
+            if (self.tier_spilled_blocks or self.tier_restored_blocks
+                    or self.tier_breakeven is not None):
+                # reported only once a tier is attached/active (the
+                # spec/SLO discipline: fabricated zeros would read as a
+                # wedged tier on a fleet dashboard)
+                out["tier_spilled_blocks"] = self.tier_spilled_blocks
+                out["tier_spilled_bytes"] = self.tier_spilled_bytes
+                out["tier_restored_blocks"] = self.tier_restored_blocks
+                out["tier_restored_bytes"] = self.tier_restored_bytes
+                out["tier_resident_bytes"] = self.tier_resident_bytes
+                out["tier_breakeven_ratio"] = self.tier_breakeven or 0.0
             out["mixed_prefill_tokens"] = self.mixed_prefill_tokens
             out["mixed_decode_tokens"] = self.mixed_decode_tokens
             if self.spec_rounds:
@@ -429,6 +495,7 @@ class ServeMetrics:
         out.update(_pcts(occ, "occupancy"))
         out.update(_pcts(act, "active_slots"))
         out.update(_pcts(kvb, "kv_bytes_tick"))
+        out.update(_pcts(tier_restore, "tier_restore_s"))
         out.update(_pcts(rf_gbps, "roofline_gbps"))
         out.update(_pcts(rf_util, "roofline_util"))
         out.update(_pcts(rf_mfu, "mfu"))
@@ -525,6 +592,33 @@ class ServeMetrics:
              "Prompt blocks reused from the prefix cache / shareable "
              "blocks requested",
              [("", s.get("prefix_hit_rate", 0.0))])
+        emit("prefix_evicted_total", "counter",
+             "Prefix-cache blocks LRU-reclaimed under pool pressure "
+             "(spilled to the host tier when --kv-tier host, dropped "
+             "otherwise)",
+             [("", s["prefix_evicted_blocks"])])
+        # -- host-RAM KV tier (only once a tier is attached — constant
+        # zeros would read as a wedged tier on a fleet dashboard)
+        if "tier_spilled_blocks" in s:
+            emit("kv_tier_blocks_total", "counter",
+                 "Host-tier block flow: spill = evicted prefix blocks "
+                 "copied to host RAM, restore = blocks staged back as "
+                 "pool blocks instead of re-prefilling",
+                 [('{op="spill"}', s["tier_spilled_blocks"]),
+                  ('{op="restore"}', s["tier_restored_blocks"])])
+            emit("kv_tier_bytes_total", "counter",
+                 "Host-tier byte flow (the restored-bytes ledger is "
+                 "prefill work the tier saved)",
+                 [('{op="spill"}', s["tier_spilled_bytes"]),
+                  ('{op="restore"}', s["tier_restored_bytes"])])
+            emit("kv_tier_resident_bytes", "gauge",
+                 "Host RAM currently holding spilled KV blocks",
+                 [("", s["tier_resident_bytes"])])
+            emit("kv_tier_breakeven_ratio", "gauge",
+                 "Measured restore-vs-recompute breakeven (re-prefill "
+                 "seconds per block / restore seconds per block; >1 = "
+                 "restoring is cheaper; 0 = not yet measured)",
+                 [("", s["tier_breakeven_ratio"])])
         emit("kv_bytes_tick_mean", "gauge",
              "Mean K/V bytes decode attention touches per tick",
              [("", s.get("kv_bytes_tick_mean", 0.0))])
@@ -681,6 +775,8 @@ class ServeMetrics:
             ("prefill_s",
              "Cumulative prefill dispatch time per request "
              "(re-prefills after preemption/recovery included)"),
+            ("tier_restore_s",
+             "Host-tier restore staging latency per restored span"),
             ("roofline_gbps",
              "Achieved-GB/s quantiles over the recorded dispatch "
              "window"),
@@ -726,6 +822,14 @@ class ServeMetrics:
             f"mean accept len {s['spec_accept_len_mean']:.2f})"
             if "spec_drafted_tokens" in s else ""
         )
+        tier = (
+            f"\nkv tier: {s['tier_restored_blocks']} blocks restored "
+            f"({s['tier_restored_bytes'] / 2**20:.2f} MiB of prefill "
+            f"saved), {s['tier_spilled_blocks']} spilled, "
+            f"{s['prefix_evicted_blocks']} evictions, breakeven "
+            f"{s['tier_breakeven_ratio']:.2f}"
+            if "tier_spilled_blocks" in s else ""
+        )
         roofline = (
             f"\nroofline: {s['roofline_gbps_mean']:.2f} GB/s mean "
             f"({s['roofline_util_mean']:.2%} of {s['hbm_gbps']:g} GB/s, "
@@ -754,5 +858,5 @@ class ServeMetrics:
             f"p99 {g('occupancy_p99', '{:.2f}')}; "
             f"active_slots mean {g('active_slots_mean', '{:.2f}')}\n"
             f"kv MiB/tick mean {mb_tick}; prefix cache hit rate {prefix}"
-            f"{spec}{roofline}"
+            f"{spec}{tier}{roofline}"
         )

@@ -200,6 +200,13 @@ class PrefixRouter:
         self.routed += 1
         return idx, False
 
+    def sticky_owner(self, key: bytes) -> int | None:
+        """The replica a prefix chain is currently sticky to, or None —
+        a read-only probe the fleet's block-shipping paths use to find
+        WHERE a spilled request's prefix blocks live so the affine
+        replica can ship them through the host tier."""
+        return self._sticky.get(key)
+
     def forget_replica(self, idx: int) -> int:
         """Drop every sticky entry pointing at ``idx`` (replica death /
         rebuild with a zeroed pool).  Returns how many were dropped."""
@@ -305,6 +312,30 @@ class ReplicaSet:
         )
         if spilled:
             req.extra["spilled"] = True
+            # fleet block shipping: a spill verdict lands the request
+            # OFF its prefix-affine replica — with the shared host tier
+            # on, the affine replica ships the chain's blocks host-side
+            # so the spill target restores them instead of re-prefilling
+            tier = getattr(self.engines[replica], "host_tier", None)
+            if tier is not None and chain is not None:
+                src = self.router.sticky_owner(key)
+                if src is not None and src != replica and self.alive[src]:
+                    self.engines[src].spill_prefix_blocks(keys=chain[0])
+                    # the shipped entries must be host-RESIDENT before
+                    # the spill target's next tick plans the admission,
+                    # or the coverage walk misses and silently
+                    # re-prefills.  Per-CHAIN wait, not drain(): the
+                    # shared tier's queue may hold a whole prefix-set
+                    # ship from a concurrent drain, and this submit
+                    # must not flush strangers' jobs — a timeout just
+                    # re-prefills, the fallback every tier path shares
+                    src_cache = self.engines[src].pool.prefix_cache
+                    have = (
+                        len(src_cache.match(chain[0]))
+                        if src_cache is not None else 0
+                    )
+                    if have:
+                        tier.await_resident(chain[0][:have])
         tracer = getattr(self.engines[replica], "tracer", None)
         if tracer is not None:
             tracer.instant("route", cat="router", args={
@@ -407,6 +438,18 @@ class ReplicaSet:
             if any(same):
                 alive = same
         stops = tuple(self.engines[idx].stop_tokens or ())
+        # fleet block shipping: the draining replica's prefixes are
+        # about to re-home, so ship its registered prefix blocks
+        # through the shared host tier FIRST — the adopting peers'
+        # teacher-forced recover() admissions (and any later traffic on
+        # those prefixes) then restore the K/V instead of re-prefilling
+        # it (the tier's writer thread pays the copies; a dead pool —
+        # pages yanked — ships nothing, which is the drop-and-recompute
+        # behavior the tier-less fleet always had)
+        tier = getattr(self.engines[idx], "host_tier", None)
+        if tier is not None:
+            self.engines[idx].spill_prefix_blocks()
+            tier.drain()  # entries must be resident before peers plan
         # the draining replica's journal segment must terminate each
         # moved stream (the peer's recover() re-admits it into the
         # peer's segment) — otherwise a restart scanning both segments
@@ -998,6 +1041,17 @@ class ReplicaRunner:
             return set()
         self._dead.add(dead_idx)
         self.router.forget_replica(dead_idx)
+        # fleet block shipping (the ReplicaSet._drain_to_peers twin):
+        # an upgrade/scale-down drain leaves the source pool intact, so
+        # its registered prefix blocks ship through the shared host
+        # tier before the prefixes re-home — the adopting peers restore
+        # instead of re-prefilling.  A terminal CRASH arrives here with
+        # the pool slabs yanked (pages None): nothing ships, exactly
+        # the drop-and-recompute the tier-less fleet always had.
+        tier = getattr(dead.engine, "host_tier", None)
+        if tier is not None:
+            dead.engine.spill_prefix_blocks()
+            tier.drain()
         dead_journal = getattr(dead.engine, "journal", None)
         adopted: set[int] = set()
         loads = [r.inflight for r in self.replicas]

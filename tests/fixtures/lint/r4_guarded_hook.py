@@ -23,6 +23,9 @@ class Engine:
     def push_unguarded_otel(self, ev):
         self.otel.offer(ev)  # BITE otel sink unguarded
 
+    def plan_unguarded_host_tier(self, keys):
+        return self.host_tier.match(keys)  # BITE host_tier hook unguarded
+
     def step_guarded(self):
         if self.tracer is not None:
             self.tracer.instant("tick")  # guarded: NOT a finding

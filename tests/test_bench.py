@@ -88,6 +88,7 @@ def test_priority_matches_config_dicts():
         + list(bench.SERVE_SPEC_CONFIGS) + list(bench.SERVE_SHARDED_CONFIGS)
         + list(bench.SERVE_RESTART_CONFIGS)
         + list(bench.SERVE_ROLLING_CONFIGS)
+        + list(bench.SERVE_TIER_CONFIGS)
         if not n.startswith("smoke")
     }
     assert set(bench.PRIORITY) == non_smoke | bench.EXTRA_CHILDREN
@@ -108,7 +109,8 @@ def test_warm_smoke_offline():
                                  and n not in bench.SERVE_SPEC_CONFIGS
                                  and n not in bench.SERVE_SHARDED_CONFIGS
                                  and n not in bench.SERVE_RESTART_CONFIGS
-                                 and n not in bench.SERVE_ROLLING_CONFIGS}
+                                 and n not in bench.SERVE_ROLLING_CONFIGS
+                                 and n not in bench.SERVE_TIER_CONFIGS}
 
 
 def test_warm_limit_covers_top_priority_only():
@@ -213,6 +215,40 @@ def test_serve_spec_smoke_offline():
     for leg in legs.values():
         assert "goodput_tok_s" in leg and "slo_attainment" in leg
     assert set(legs["spec"]["compile_counts"]) == {"mixed_step"}
+
+
+def test_serve_tier_smoke_offline():
+    """The tiered-KV child: one capacity-stressed shared-prompt trace
+    (prefix working set past pool capacity, distinct prompts cycled so
+    every repeat outlives its cached blocks) through tier-off and
+    tier-on engines — the ISSUE's acceptance bar: strictly higher
+    prefix hit-rate AND strictly fewer prefill tokens dispatched on the
+    tier leg, real restores with a reported latency p99, token parity
+    (restored K/V is bit-identical to recompute), and zero compiles
+    added by the tier (one warmed restore/slice program each)."""
+    res = bench._spawn("smoke_serve_prefix_tiered", 600,
+                       env={"BENCH_PLATFORM": "cpu"})
+    assert res.get("ok") is True, res
+    assert res["token_parity_tier_vs_off"] is True
+    assert res["prefix_hit_rate"] > res["prefix_hit_rate_off"]
+    assert res["prefill_tokens"] < res["prefill_tokens_off"]
+    assert res["restored_blocks"] > 0
+    assert res["restore_s_p99"] > 0
+    assert res["compiles_added_by_tier"] == 0
+    # the workload actually stressed capacity (the whole point): the
+    # shareable working set exceeds the pool and the tier-off leg
+    # visibly evicted
+    assert res["working_set_over_capacity"] > 1.0
+    legs = res["legs"]
+    assert legs["tier_off"]["prefix_evicted_blocks"] > 0
+    assert legs["tier_on"]["tier_spilled_blocks"] > 0
+    # the tier's two programs compile exactly once each; mixed_step
+    # stays at its warmed bucket count
+    assert legs["tier_on"]["compile_counts"]["restore_block"] == 1
+    assert legs["tier_on"]["compile_counts"]["slice_block"] == 1
+    # slo_gate-compatible summary fields on both legs
+    for leg in legs.values():
+        assert "goodput_tok_s" in leg and "slo_attainment" in leg
 
 
 def test_serve_sharded_smoke_offline():

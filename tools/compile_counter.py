@@ -96,6 +96,17 @@ def assert_serve_compiles_bounded(
     """
     counts = engine.compile_counts()
     problems = []
+    # the host tier's two programs (present only with the tier
+    # attached): block ids are traced and the block layout fixed, so
+    # each must stay at ONE compile however many blocks spill/restore
+    for prog in ("restore_block", "slice_block"):
+        n = counts.pop(prog, None)
+        if n is not None and n > 1:
+            problems.append(
+                f"{prog} compiled {n}x (must be <= 1: the host tier's "
+                "programs take the block id as a traced scalar, so "
+                "spills/restores never specialize per block)"
+            )
     if getattr(engine, "mixed", False):
         if set(counts) != {"mixed_step"}:
             problems.append(
@@ -330,6 +341,70 @@ def _self_check() -> None:
     held = eng.pool.stats()["request_held"]
     assert held == 0, f"unified tick leaked {held} blocks"
     print(f"compile counts OK (unified tick): {eng.compile_counts()}")
+
+    # the tiered KV prefix cache (--kv-tier host): a pool too small for
+    # the prefix working set churns through spill (LRU reclaim) and
+    # restore (repeat admissions) every round — restore-heavy ticks
+    # must SHARE the warmed mixed step, the tier's only program is the
+    # single restore_block landing step (warmed in warmup), and
+    # clone_fresh must CARRY the tier (host entries survive a rebuild:
+    # the zeroed pool restores instead of re-prefilling) while sharing
+    # both compiled callables — tier-on churn compiles NOTHING
+    from llm_np_cp_tpu.serve.host_tier import HostTier
+
+    tier = HostTier(64 << 20)
+    eng = ServeEngine(
+        params, cfg, sampler=Sampler(kind="greedy"), max_slots=2,
+        num_blocks=12, block_size=8, max_seq_len=64,
+        cache_dtype=jnp.float32, mixed_step="on",
+        enable_prefix_cache=True, host_tier=tier,
+    )
+    tier_prompts = [rng.integers(1, 200, size=24) for _ in range(6)]
+    eng.warmup([int(p.size) for p in tier_prompts], max_new_tokens=6)
+    warm = dict(eng.compile_counts())
+    assert warm.get("restore_block") == 1, (
+        f"restore_block not warmed exactly once: {warm}"
+    )
+    assert warm.get("slice_block") == 1, (
+        f"slice_block not warmed exactly once: {warm}"
+    )
+    with CompileCounter().watch() as counter:
+        for rep in range(3):  # rounds 2+ restore from the host tier
+            for p in tier_prompts:
+                eng.submit(p, 4)
+                eng.run_until_complete()
+            tier.drain()
+    assert counter.count == 0, (
+        f"tier-on composition churn compiled: {counter.events}"
+    )
+    assert eng.compile_counts() == warm
+    tier_stats = tier.stats()
+    assert tier_stats["restored_blocks"] > 0, (
+        "tier never restored — bad self-check workload"
+    )
+    assert_serve_compiles_bounded(engine=eng, distinct_prefill_shapes=0)
+    live = [eng.submit(p, 4) for p in tier_prompts[:2]]
+    eng.step()
+    rebuilt = eng.clone_fresh()
+    assert rebuilt.host_tier is tier, "clone_fresh dropped the tier"
+    assert rebuilt._restore_block is eng._restore_block, (
+        "clone_fresh did not share the restore_block program"
+    )
+    assert rebuilt._mixed_step is eng._mixed_step
+    with CompileCounter().watch() as counter:
+        for r in live:
+            rebuilt.recover(
+                r.prompt, r.max_new_tokens, request_id=r.req_id,
+                seed=r.seed, generated=list(r.generated),
+            )
+        rebuilt.run_until_complete()
+    assert counter.count == 0, (
+        f"tiered restart + recovery replay compiled: {counter.events}"
+    )
+    tier.close()
+    print(f"compile counts OK (kv tier): {eng.compile_counts()}, "
+          f"{tier_stats['restored_blocks']} restored / "
+          f"{tier_stats['spilled_blocks']} spilled")
 
     # speculative serving (spec_k > 0): the verify lanes are a STATIC
     # [R, spec_k+1] extension of the mixed step, so per-tick verify-width

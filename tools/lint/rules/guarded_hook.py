@@ -7,9 +7,10 @@ The serve stack's optional instruments — the ``tracer``
 log (serve/request_log.RequestLog), the ``sentinel`` tick anomaly
 detector, the ``slo`` goodput tracker (serve/slo.py), the
 ``actions`` lifecycle auto-action policy (serve/lifecycle.py), the
-``telemetry`` device roofline model (serve/telemetry.TelemetryModel)
-and the ``otel`` OTLP span sink (serve/otel.OtlpExporter, hung off the
-TraceRecorder) — are OFF by
+``telemetry`` device roofline model (serve/telemetry.TelemetryModel),
+the ``otel`` OTLP span sink (serve/otel.OtlpExporter, hung off the
+TraceRecorder) and the ``host_tier`` host-RAM KV block tier
+(serve/host_tier.HostTier) — are OFF by
 default, spelled as ``None`` attributes.  The zero-overhead contract is that every hook call sits
 behind an ``is None`` / ``is not None`` check in the same function, so
 instruments-off costs an attribute load and a branch: no dict built for
@@ -44,7 +45,7 @@ from tools.lint.core import (
 RULE_ID = "R4"
 
 HOOKS = ("tracer", "faults", "journal", "request_log", "sentinel", "slo",
-         "actions", "telemetry", "otel")
+         "actions", "telemetry", "otel", "host_tier")
 # engine methods where binding self.tracer/self.metrics/self.journal to
 # a local is fine: construction, cloning, and the warmup
 # suspend/restore swap — none of them run inside a supervised tick
@@ -169,7 +170,7 @@ class _Rule:
                     continue
                 if chain[1] not in ("tracer", "metrics", "journal",
                                     "request_log", "actions",
-                                    "telemetry"):
+                                    "telemetry", "host_tier"):
                     continue
                 if not any(isinstance(t, ast.Name) for t in node.targets):
                     continue

@@ -68,6 +68,13 @@ DOMAIN_TABLE: tuple[tuple[str, str, str], ...] = (
     # the journal: engine-side hooks only enqueue under the lock)
     ("serve/request_log.py", "RequestLog._writer*", "reqlog"),
     ("serve/request_log.py", "*", "engine"),
+    # the host-RAM KV tier's WRITER THREAD owns the host block store
+    # (spills insert, capacity evicts, restores read/stage); the
+    # enqueue side runs from whatever thread holds the engine (tick
+    # thread, fleet drain on loop/supervisor threads), so the job
+    # queue, completion map and counters are lock-protected shared
+    ("serve/host_tier.py", "HostTier._writer*", "host_tier"),
+    ("serve/host_tier.py", "*", "engine"),
     # the OTLP exporter's WRITER THREAD owns the open-span map and the
     # HTTP plumbing; offer() is called from WHATEVER thread holds the
     # recorder (engine tick, event loop, supervisor), so the enqueue
@@ -151,6 +158,17 @@ OTEL_STATE: tuple[tuple[str, ...], ...] = (
     ("_wopen",),
 )
 
+# host-tier-writer-thread-owned state (serve/host_tier.py): the ``_w``
+# naming convention — only the writer thread inserts/evicts host
+# blocks and maintains the resident byte count.  The engine side READS
+# the store lock-free (dict lookups, benign race: a lost entry is a
+# restore miss the engine already re-prefills) and communicates
+# mutations through the lock-protected job queue.
+HOST_TIER_STATE: tuple[tuple[str, ...], ...] = (
+    ("_wentries",),
+    ("_wbytes",),
+)
+
 # lifecycle-controller-owned state (serve/lifecycle.py): the in-flight
 # roll flag and history — only LifecycleController methods (the
 # lifecycle domain) drive a roll; handlers and tick code must call
@@ -172,6 +190,8 @@ DOMAIN_OWNED: tuple[tuple[str, tuple, str], ...] = (
      "enqueue a record for the writer thread instead"),
     ("otel", OTEL_STATE,
      "offer() the event for the writer thread instead"),
+    ("host_tier", HOST_TIER_STATE,
+     "enqueue a spill/restore job for the writer thread instead"),
     ("lifecycle", LIFECYCLE_STATE,
      "drive the roll through LifecycleController methods instead"),
 )
@@ -248,6 +268,22 @@ LOCK_STATE: tuple[dict, ...] = (
         "lock": "_lock",
         "attrs": {"_pending", "_stopping", "n_spans", "n_batches",
                   "n_dropped", "n_export_errors"},
+        "lock_assumed": set(),
+    },
+    {
+        # the host tier's enqueue↔writer boundary: the job queue, the
+        # staged-restore completion map, the ticket counter, the flow
+        # counters, and the breakeven measurements are the shared state
+        "file": "serve/host_tier.py",
+        "class": "HostTier",
+        "lock": "_lock",
+        "attrs": {"_pending", "_done", "_abandoned",
+                  "_pending_spill_keys", "_stopping",
+                  "_next_ticket", "n_spilled", "spilled_bytes",
+                  "n_restored", "restored_bytes", "n_restore_miss",
+                  "n_dropped", "n_skipped", "restore_s",
+                  "restore_s_per_block", "restore_gbps",
+                  "prefill_tok_s", "_probed_bytes"},
         "lock_assumed": set(),
     },
     {

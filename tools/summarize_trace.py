@@ -15,6 +15,9 @@ from ``GET /debug/trace``) and prints:
 - **roofline** (when the trace was recorded with ``--roofline``) —
   per-tick achieved GB/s and roofline-utilization percentiles from the
   telemetry tick args, split vs mixed ticks reported separately;
+- **kv_tier** (when the trace was recorded with ``--kv-tier host``) —
+  spilled/restored bytes and restore-latency percentiles from the
+  host-tier tick args;
 - **per-request lifecycle table** — queued / prefill / decode (and, when
   the HTTP layer traced it, the accept→response bracket) per request,
   with eviction/recovery counts and the finish reason.
@@ -291,6 +294,37 @@ def roofline(events: list[dict]) -> dict[str, dict[str, float]] | None:
     return out or None
 
 
+def kv_tier(events: list[dict]) -> dict[str, float] | None:
+    """Host-tier flow from the per-tick ``tier_spill_bytes`` /
+    ``tier_restore_bytes`` / ``tier_restore_us`` args (the engine
+    stamps them when ``--kv-tier host`` is on): total spilled/restored
+    bytes, how many ticks moved blocks either way, and restore-latency
+    percentiles over the ticks that restored.  None when no tick
+    carries the args (the tier was off)."""
+    ticks = [
+        (ev.get("args") or {}) for ev in events
+        if ev.get("ph") == "X" and ev.get("cat") == "tick"
+        and "tier_spill_bytes" in (ev.get("args") or {})
+    ]
+    if not ticks:
+        return None
+    spill = [a["tier_spill_bytes"] for a in ticks]
+    restore = [a["tier_restore_bytes"] for a in ticks]
+    lat = [a["tier_restore_us"] for a in ticks if a["tier_restore_bytes"]]
+    out = {
+        "ticks": len(ticks),
+        "spill_bytes": float(sum(spill)),
+        "restore_bytes": float(sum(restore)),
+        "spill_ticks": sum(1 for b in spill if b),
+        "restore_ticks": sum(1 for b in restore if b),
+    }
+    if lat:
+        out["restore_us_p50"] = _pct(lat, 50)
+        out["restore_us_p99"] = _pct(lat, 99)
+        out["restore_us_mean"] = sum(lat) / len(lat)
+    return out
+
+
 def slowest_ticks(events: list[dict], k: int) -> list[dict]:
     ticks = [e for e in events
              if e.get("ph") == "X" and e.get("cat") == "tick"]
@@ -381,6 +415,20 @@ def format_summary(events: list[dict], top: int = 5) -> str:
                 f"mean {r['util_mean']:.2%}; device "
                 f"{r['device_s_total'] * 1e3:.2f} ms"
             )
+    tier = kv_tier(events)
+    if tier is not None:
+        lines.append(
+            f"== kv_tier ==\n"
+            f"spill {tier['spill_bytes'] / 2**20:.2f} MiB over "
+            f"{tier['spill_ticks']} ticks; restore "
+            f"{tier['restore_bytes'] / 2**20:.2f} MiB over "
+            f"{tier['restore_ticks']} ticks"
+            + (
+                f"; restore latency p50 {tier['restore_us_p50']:.0f}us "
+                f"p99 {tier['restore_us_p99']:.0f}us"
+                if "restore_us_p50" in tier else ""
+            )
+        )
     lines.append(f"== top {top} slowest ticks ==")
     for ev in slowest_ticks(events, top):
         args = ev.get("args") or {}
