@@ -338,6 +338,45 @@ SERVE_TIER_CONFIGS = {
                                       num_blocks=12, tier_gb=1.0),
 }
 
+# Multi-tenant fairness leg (serve/tenants.py + the plan_tick
+# fair-share prefill order): ONE merged arrival schedule built from
+# three independent per-tenant Poisson processes at skewed rates — a
+# chat-like tenant (short prompts, short decodes, high rate), a
+# completion tenant (medium), and a prefill-heavy batch tenant (long
+# prompts, few tokens, low rate) — replayed twice on one engine
+# geometry: fairness off (prefill budget fills in admission order) vs
+# fairness on (smallest-accumulated-cost-share tenant first).  The
+# observables are the accounting-plane claims on identical arrivals:
+# per-tenant attainment / goodput / cost share from the TenantLedger
+# (what tools/slo_gate.py --min-tenant-attainment gates), each
+# tenant's mean first-token RANK (ordinal, so the fairness reorder is
+# visible without trusting CPU wall clocks), TOKEN PARITY between the
+# legs (fairness reorders prefill scheduling, never content), and
+# compiles_added_by_trace == 0 on both legs (ordering is host-side;
+# the ragged buckets don't change).
+SERVE_TENANT_CONFIGS = {
+    "serve_tenant_poisson": dict(
+        model="llama1b", slots=8, block_size=128,
+        tenants=dict(
+            chat=dict(requests=16, rate=24.0, prompt_len=128,
+                      max_tokens=32),
+            complete=dict(requests=10, rate=8.0, prompt_len=384,
+                          max_tokens=64),
+            batch=dict(requests=6, rate=3.0, prompt_len=512,
+                       max_tokens=8),
+        )),
+    "smoke_serve_tenant": dict(
+        model="tiny", slots=4, block_size=8,
+        tenants=dict(
+            chat=dict(requests=6, rate=120.0, prompt_len=16,
+                      max_tokens=8),
+            complete=dict(requests=3, rate=60.0, prompt_len=24,
+                          max_tokens=10),
+            batch=dict(requests=3, rate=30.0, prompt_len=48,
+                       max_tokens=4),
+        )),
+}
+
 SPEC_CONFIGS = {
     # batched self-speculation: bf16 target + int8 self-draft, γ=4
     "int8_spec_bs8": dict(model="llama1b", batch=8, prompt_len=128,
@@ -381,6 +420,7 @@ PRIORITY = [
     "serve_restart_poisson",  # kill -9 + journal replay + client resume
     "serve_rolling_upgrade",  # zero-downtime weight swap over the DP fleet
     "serve_sharded_poisson",  # TP pool sharding + DP replicas vs single chip
+    "serve_tenant_poisson",  # fair-share prefill + per-tenant accounting
     "gemma2_2b_bs8",      # Gemma north-star number (VERDICT task 3)
     "int8_bs8",           # roofline-gap anchor (VERDICT task 6)
     "int8a8_bs8",         # W8A8 int8-MXU einsums vs that anchor
@@ -414,6 +454,7 @@ assert set(PRIORITY) == {
     + list(SERVE_MIXED_CONFIGS) + list(SERVE_SPEC_CONFIGS)
     + list(SERVE_SHARDED_CONFIGS) + list(SERVE_RESTART_CONFIGS)
     + list(SERVE_ROLLING_CONFIGS) + list(SERVE_TIER_CONFIGS)
+    + list(SERVE_TENANT_CONFIGS)
     if not n.startswith("smoke")
 } | EXTRA_CHILDREN, "PRIORITY out of sync with config dicts"
 
@@ -463,6 +504,10 @@ TIMEOUTS = {
     # rebuilds + teacher-forced drain re-prefills inside the measured
     # span
     "serve_rolling_upgrade": 850,
+    # two trace replays (fairness off/on) on one param build; the
+    # merged 32-request trace mixes three prompt-length bands, so the
+    # bucket warmup compiles one mixed_step set per leg
+    "serve_tenant_poisson": 850,
     # prefill-dominated: the marginal measurement's extra prefill+half
     # decode per rep nearly doubles measured-phase wall time
     "llama3b_seq2048_bs8": 700,
@@ -1502,6 +1547,164 @@ def run_serve_tier_config(name: str) -> dict:
         "compiles_added_by_tier": on["compiles_added_by_trace"],
         "legs": per_leg,
         "ragged_kernel_probe": ragged_err or "ok",
+    }
+
+
+def run_serve_tenant_config(name: str) -> dict:
+    """Multi-tenant fairness: three per-tenant Poisson processes at
+    skewed rates merged into ONE arrival schedule, replayed twice on
+    one engine geometry — fairness off vs on — reporting per-tenant
+    attainment / goodput / cost share from the TenantLedger, mean
+    first-token ranks (the ordinal view of the prefill reorder), token
+    parity between the legs, and zero added compiles."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from llm_np_cp_tpu.ops.sampling import Sampler
+    from llm_np_cp_tpu.serve import ServeEngine, TenantLedger, poisson_trace
+    from llm_np_cp_tpu.serve.engine import pool_geometry
+    from llm_np_cp_tpu.serve.slo import SLOPolicy, SLOTracker
+
+    t0 = time.perf_counter()
+    spec = SERVE_TENANT_CONFIGS[name]
+    config, params = _build_model(spec["model"], tag=name, t0=t0)
+    _phase(name, "params_built", t0)
+
+    bs = spec["block_size"]
+    chunk = min(bs * 2, 256)
+    tenants = spec["tenants"]
+    max_prompt = max(t["prompt_len"] for t in tenants.values())
+    max_new = max(t["max_tokens"] for t in tenants.values())
+    _, num_blocks, max_seq_len = pool_geometry(
+        max_prompt, max_new, spec["slots"], bs, prefill_chunk=chunk,
+    )
+
+    # one rng per tenant: each tenant is its OWN Poisson process at its
+    # own rate (seed offsets keep per-request sampler seeds unique);
+    # the merged, arrival-sorted schedule is identical for both legs
+    trace: list[dict] = []
+    for idx, (tenant, tspec) in enumerate(sorted(tenants.items())):
+        rng = np.random.default_rng(31 + idx)
+        sub = poisson_trace(
+            rng, tspec["requests"], rate_rps=tspec["rate"],
+            prompt_len_range=(max(tspec["prompt_len"] // 2, 1),
+                              tspec["prompt_len"]),
+            max_new_tokens=tspec["max_tokens"],
+            vocab_size=config.vocab_size,
+            seed_base=31 + 1000 * idx,
+        )
+        trace.extend(dict(item, tenant=tenant) for item in sub)
+    trace.sort(key=lambda item: item["arrival_s"])
+    n_requests = len(trace)
+    _phase(name, "trace_built", t0, requests=n_requests)
+
+    per_leg: dict = {}
+    tokens_by_leg: dict = {}
+    for leg in ("fair_off", "fair_on"):
+        ledger = TenantLedger(
+            fairness=(leg == "fair_on"),
+            policy=SLOPolicy(ttft_s=5.0, tpot_s=1.0, target=0.99),
+        )
+        engine = ServeEngine(
+            params, config,
+            sampler=Sampler(kind="greedy"),
+            max_slots=spec["slots"],
+            num_blocks=num_blocks,
+            block_size=bs,
+            max_seq_len=max_seq_len,
+            prefill_chunk=chunk,
+            cache_dtype=jnp.bfloat16,
+            mixed_step="on",
+            tenants=ledger,
+        )
+        ledger.clock = engine.clock
+        engine.warmup([int(t["prompt"].size) for t in trace],
+                      max_new_tokens=max_new)
+        warm_compiles = dict(engine.compile_counts())
+        engine.metrics.slo = SLOTracker(ledger.policy, clock=engine.clock)
+        _phase(name, f"warmed_{leg}", t0)
+        snap = engine.replay_trace(trace)
+        _phase(name, f"trace_drained_{leg}", t0, ticks=snap["ticks"])
+        finished = list(engine.scheduler.finished)
+        tokens_by_leg[leg] = {
+            r.req_id: list(r.generated) for r in finished
+        }
+        # ordinal fairness observable: each tenant's mean rank in
+        # first-token order — reorder wins survive CPU clock noise
+        ranked = sorted(
+            (r for r in finished if r.first_token_time is not None),
+            key=lambda r: r.first_token_time,
+        )
+        ranks: dict[str, list[int]] = {}
+        for rank, r in enumerate(ranked):
+            ranks.setdefault(r.tenant, []).append(rank)
+        ten_detail: dict[str, dict] = {}
+        for tenant, ent in ledger.snapshot()["tenants"].items():
+            d: dict = {
+                "requests": ent["requests"],
+                "tokens": ent["tokens"],
+                "cost_share": round(ent["cost_share"], 4),
+                "throttled": ent["throttled"],
+                "first_token_rank_mean": round(
+                    sum(ranks.get(tenant, [0]))
+                    / max(len(ranks.get(tenant, [])), 1), 2),
+            }
+            if "slo" in ent:
+                d["slo_attainment"] = ent["slo"].get("slo_attainment")
+                d["goodput_tok_s"] = round(
+                    ent["slo"].get("goodput_tok_s", 0.0), 1)
+            ten_detail[tenant] = d
+        counts = engine.compile_counts()
+        per_leg[leg] = {
+            "ok": (snap["finished"] == n_requests
+                   and set(ten_detail) == set(tenants)
+                   and all(ten_detail[t]["requests"]
+                           == tenants[t]["requests"] for t in tenants)),
+            "throughput_tok_s": round(snap["throughput_tok_s"], 1),
+            "ttft_s_p50": round(snap.get("ttft_s_p50", float("nan")), 4),
+            "ttft_s_p99": round(snap.get("ttft_s_p99", float("nan")), 4),
+            "ticks": snap["ticks"],
+            "goodput_tok_s": round(snap.get("goodput_tok_s", 0.0), 1),
+            "slo_attainment": snap.get("slo_attainment"),
+            "compiles_added_by_trace": (
+                counts.get("mixed_step", 0)
+                - warm_compiles.get("mixed_step", 0)
+            ),
+            "tenants": ten_detail,
+        }
+        del engine
+    parity = tokens_by_leg["fair_off"] == tokens_by_leg["fair_on"]
+    off, on = per_leg["fair_off"], per_leg["fair_on"]
+
+    def worst_att(leg: dict) -> float | None:
+        atts = [d["slo_attainment"] for d in leg["tenants"].values()
+                if d.get("slo_attainment") is not None]
+        return min(atts) if atts else None
+
+    return {
+        "config": name,
+        "ok": (all(r["ok"] for r in per_leg.values()) and parity
+               and off["compiles_added_by_trace"] == 0
+               and on["compiles_added_by_trace"] == 0),
+        "requests": n_requests,
+        "slots": spec["slots"],
+        "pool_blocks": num_blocks,
+        "block_size": bs,
+        "tenant_mix": {
+            t: dict(requests=ts["requests"], rate_rps=ts["rate"])
+            for t, ts in sorted(tenants.items())
+        },
+        "token_parity_fair_vs_off": parity,
+        # headline: worst tenant's attainment with/without fairness —
+        # what tools/slo_gate.py --min-tenant-attainment consumes
+        "worst_tenant_attainment": worst_att(on),
+        "worst_tenant_attainment_off": worst_att(off),
+        "throughput_tok_s": on["throughput_tok_s"],
+        "throughput_tok_s_off": off["throughput_tok_s"],
+        "ttft_s_p99": on["ttft_s_p99"],
+        "ttft_s_p99_off": off["ttft_s_p99"],
+        "compiles_added_by_fairness": on["compiles_added_by_trace"],
+        "legs": per_leg,
     }
 
 
@@ -2633,6 +2836,7 @@ def run_warm() -> dict:
         and n not in SERVE_RESTART_CONFIGS
         and n not in SERVE_ROLLING_CONFIGS
         and n not in SERVE_TIER_CONFIGS
+        and n not in SERVE_TENANT_CONFIGS
     ]
     for name in warmable[:warm_limit]:
         spec = {**DECODE_CONFIGS, **PREFILL_CONFIGS}[name]
@@ -2987,6 +3191,8 @@ def child_main(mode: str) -> None:
         out = run_serve_rolling_config(mode)
     elif mode in SERVE_SHARDED_CONFIGS:
         out = run_serve_sharded_config(mode)
+    elif mode in SERVE_TENANT_CONFIGS:
+        out = run_serve_tenant_config(mode)
     else:
         raise SystemExit(f"unknown config {mode!r}")
     print(json.dumps(out), flush=True)

@@ -315,6 +315,38 @@ def _add_serve_engine_flags(p: argparse.ArgumentParser,
                    "when the 5m SLO burn rate exceeds B (release at "
                    "B/2; needs --slo-ttft/--slo-tpot for burn to be "
                    "measured)")
+    p.add_argument("--tenants", action=argparse.BooleanOptionalAction,
+                   default=False,
+                   help="multi-tenant accounting (serve/tenants.py): "
+                   "requests carry an X-Tenant-Id header (or a "
+                   "\"tenant\" body field; absent = \"default\"), and "
+                   "every observability surface becomes tenant-scoped — "
+                   "per-tenant request/token/device-cost totals and SLO "
+                   "burn as tenant-labeled series on /metrics, "
+                   "GET /debug/tenants JSON, the tenant on journal "
+                   "records, request-log lines and trace spans.  "
+                   "Default: off (hooks are zero-overhead no-ops)")
+    p.add_argument("--tenant-fairness",
+                   action=argparse.BooleanOptionalAction, default=False,
+                   help="fair-share admission (implies --tenants): each "
+                   "tick's prefill budget fills "
+                   "smallest-running-cost-share-first across tenants "
+                   "(within a tenant, oldest-first; running decodes are "
+                   "never starved).  Single-tenant traffic is "
+                   "byte-identical to fairness off")
+    p.add_argument("--tenant-max-inflight", type=int, default=0,
+                   metavar="N",
+                   help="per-tenant in-flight cap (implies --tenants): "
+                   "a tenant with N live requests gets 429 + "
+                   "Retry-After on the next, counted as "
+                   "llm_serve_tenant_throttled_total{tenant=}.  "
+                   "0 = uncapped")
+    p.add_argument("--max-tenant-series", type=int, default=20,
+                   metavar="K",
+                   help="Prometheus cardinality bound for tenant-"
+                   "labeled series: the top K tenants by attributed "
+                   "cost keep their own label, the rest roll up into "
+                   "tenant=\"other\" (/debug/tenants always shows all)")
     p.add_argument("--roofline", action=argparse.BooleanOptionalAction,
                    default=False,
                    help="device roofline telemetry "
@@ -706,6 +738,45 @@ def _build_serve_engine(args, params, config, *, prog: str,
                   f"dispatches against {telemetry.hbm_gbps:g} GB/s "
                   "(achieved GB/s + MFU on /metrics, per-request cost "
                   "attribution in the request log)")
+    slo_ttft = getattr(args, "slo_ttft", 0.0) or None
+    slo_tpot = getattr(args, "slo_tpot", 0.0) or None
+    slo_policy = None
+    if slo_ttft or slo_tpot:
+        from llm_np_cp_tpu.serve.slo import SLOPolicy
+
+        slo_policy = SLOPolicy(
+            ttft_s=slo_ttft, tpot_s=slo_tpot,
+            target=getattr(args, "slo_target", 0.99),
+        )
+    tenants = None
+    tenant_fairness = getattr(args, "tenant_fairness", False)
+    tenant_cap = getattr(args, "tenant_max_inflight", 0)
+    if tenant_cap < 0:
+        raise SystemExit(
+            f"--tenant-max-inflight must be >= 0, got {tenant_cap}")
+    if getattr(args, "tenants", False) or tenant_fairness or tenant_cap:
+        max_series = getattr(args, "max_tenant_series", 20)
+        if max_series < 1:
+            raise SystemExit(
+                f"--max-tenant-series must be >= 1, got {max_series}")
+        from llm_np_cp_tpu.serve.tenants import TenantLedger
+
+        # one ledger PER ENGINE (R3: lock-grouped shared state, like
+        # metrics); replica builds clone their own via
+        # _fresh_replica_engine, and the scrape/debug layers aggregate
+        tenants = TenantLedger(
+            fairness=tenant_fairness,
+            max_inflight=tenant_cap or None,
+            max_series=max_series,
+            policy=slo_policy,
+        )
+        if not quiet:
+            print(f"[{prog}] tenant accounting ACTIVE: "
+                  f"fairness={'on' if tenant_fairness else 'off'}, "
+                  f"max-inflight={tenant_cap or 'uncapped'}, "
+                  f"top-{max_series} tenants labeled on /metrics "
+                  "(X-Tenant-Id header names the tenant; "
+                  "GET /debug/tenants for the full breakdown)")
     host_tier = shared_host_tier
     if host_tier is None and getattr(args, "kv_tier", "off") == "host":
         if not args.prefix_cache:
@@ -773,21 +844,16 @@ def _build_serve_engine(args, params, config, *, prog: str,
         actions=actions,
         telemetry=telemetry,
         host_tier=host_tier,
+        tenants=tenants,
         spec_k=(
             getattr(args, "spec_k", 4)
             if getattr(args, "speculative_serve", False) else 0
         ),
     )
-    slo_ttft = getattr(args, "slo_ttft", 0.0) or None
-    slo_tpot = getattr(args, "slo_tpot", 0.0) or None
-    if slo_ttft or slo_tpot:
-        from llm_np_cp_tpu.serve.slo import SLOPolicy, SLOTracker
+    if slo_policy is not None:
+        from llm_np_cp_tpu.serve.slo import SLOTracker
 
-        engine.metrics.slo = SLOTracker(
-            SLOPolicy(ttft_s=slo_ttft, tpot_s=slo_tpot,
-                      target=getattr(args, "slo_target", 0.99)),
-            clock=engine.clock,
-        )
+        engine.metrics.slo = SLOTracker(slo_policy, clock=engine.clock)
         if not quiet:
             print(f"[{prog}] SLO accounting ACTIVE: "
                   f"ttft<={slo_ttft or '-'}s tpot<={slo_tpot or '-'}s "

@@ -69,6 +69,12 @@ Modules:
   wide-event JSON line per terminal request (trace id, route, prefix
   reuse, survival lineage, per-phase latencies, SLO verdict), written
   off the tick thread with the journal's writer discipline.
+- ``tenants``     — multi-tenant accounting (``TenantLedger``):
+  per-tenant request/token/device-cost totals, per-tenant SLO burn,
+  fair-share prefill ordering, per-tenant in-flight caps, and
+  bounded-cardinality tenant-labeled Prometheus series; ``X-Tenant-Id``
+  identities normalized through ``normalize_tenant``; zero-overhead
+  is-None hooks when off.
 - ``replica``     — mesh-scale-out: ``ReplicaSet``/``ReplicaRunner``
   run N data-parallel engine replicas (each optionally TP-sharded via
   ``ServeEngine(mesh_plan=...)`` on its own mesh slice) behind a
@@ -122,9 +128,15 @@ from llm_np_cp_tpu.serve.scheduler import (
     Request,
     RequestState,
     Scheduler,
+    TenantThrottled,
 )
 from llm_np_cp_tpu.serve.spec import DraftState
 from llm_np_cp_tpu.serve.telemetry import TelemetryModel
+from llm_np_cp_tpu.serve.tenants import (
+    TenantLedger,
+    aggregate_tenants,
+    normalize_tenant,
+)
 from llm_np_cp_tpu.serve.trace import poisson_trace
 from llm_np_cp_tpu.serve.tracing import TraceRecorder
 
@@ -154,9 +166,13 @@ __all__ = [
     "ServeEngine",
     "ServeMetrics",
     "TelemetryModel",
+    "TenantLedger",
+    "TenantThrottled",
     "TickSentinel",
     "TraceRecorder",
     "aggregate_slo",
+    "aggregate_tenants",
+    "normalize_tenant",
     "poisson_trace",
     "pool_geometry",
     "prefix_block_keys",

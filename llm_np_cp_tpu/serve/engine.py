@@ -126,6 +126,7 @@ from llm_np_cp_tpu.serve.scheduler import (
     Request,
     RequestState,
     Scheduler,
+    TenantThrottled,
 )
 from llm_np_cp_tpu.serve.telemetry import (
     mixed_tick_kv_read,
@@ -290,6 +291,7 @@ class ServeEngine:
         telemetry: Any = None,
         weights_version: int = 0,
         host_tier: Any = None,
+        tenants: Any = None,
         spec_k: int = 0,
         spec_ngram: int = 3,
         spec_min_accept: float = 0.1,
@@ -519,6 +521,13 @@ class ServeEngine:
         # and zero recompiles (compile-counter telemetry section).
         # Same is-None zero-overhead discipline as faults/tracer
         self.telemetry = telemetry
+        # multi-tenant accounting ledger (serve/tenants.TenantLedger):
+        # per-tenant requests/tokens/cost/SLO folded in at terminals,
+        # the fairness sort for plan_tick, and the per-tenant in-flight
+        # cap.  Host-side bookkeeping over existing tick outputs — zero
+        # dispatches, zero host syncs, zero recompiles.  Same is-None
+        # zero-overhead discipline as faults/tracer
+        self.tenants = tenants
         # which checkpoint these params came from: stamped onto every
         # request at admission (journal/request-log carry it), bumped
         # by a rolling upgrade's clone_fresh(params=..., ...)
@@ -1807,6 +1816,7 @@ class ServeEngine:
         arrival_time: float | None = None,
         trace_id: str | None = None,
         speculative: bool = False,
+        tenant: str = "default",
         _recovered: bool = False,
     ) -> Request:
         prompt = np.asarray(prompt_ids, dtype=np.int32).reshape(-1)
@@ -1845,6 +1855,27 @@ class ServeEngine:
         self._next_id = max(self._next_id, request_id) + 1
         if deadline_s is not None and deadline_s <= 0:
             raise ValueError(f"deadline_s must be > 0, got {deadline_s}")
+        # per-tenant in-flight cap: counted over the LIVE ledger (queued
+        # + running), stateless so recovery replays and drains can never
+        # leak a count.  Recovered work is exempt like the queue cap —
+        # the cap must not orphan a request the engine already accepted.
+        if self.tenants is not None and not _recovered:
+            cap = self.tenants.max_inflight
+            if cap is not None:
+                n_live = sum(
+                    1 for r in self._requests.values()
+                    if r.tenant == tenant
+                )
+                if n_live >= cap:
+                    self.tenants.on_throttle(tenant)
+                    self.metrics.on_reject()
+                    if self.tracer is not None:
+                        self.tracer.instant(
+                            "tenant-throttled", cat="request",
+                            args={"tenant": tenant, "inflight": n_live,
+                                  "cap": cap},
+                        )
+                    raise TenantThrottled(tenant, n_live, cap)
         req = Request(
             req_id=request_id,
             prompt=prompt,
@@ -1857,6 +1888,7 @@ class ServeEngine:
             # there) so a journal replay onto a spec-enabled rebuild
             # resumes drafting
             speculative=bool(speculative),
+            tenant=tenant,
         )
         req.submit_time = self.clock()
         if deadline_s is not None:
@@ -1928,6 +1960,7 @@ class ServeEngine:
         trace_id: str | None = None,
         lineage: dict | None = None,
         speculative: bool = False,
+        tenant: str = "default",
         weights_version: int | None = None,
     ) -> Request:
         """Resubmit a request that was in flight when a previous engine
@@ -1971,7 +2004,8 @@ class ServeEngine:
         req = self.submit(
             prompt_ids, max_new_tokens, request_id=request_id, seed=seed,
             callback=callback, on_event=on_event, deadline_s=deadline_s,
-            trace_id=trace_id, speculative=speculative, _recovered=True,
+            trace_id=trace_id, speculative=speculative, tenant=tenant,
+            _recovered=True,
         )
         if deadline_at is not None:
             req.deadline = deadline_at
@@ -2009,6 +2043,7 @@ class ServeEngine:
         reason: str,
         trace_id: str | None = None,
         lineage: dict | None = None,
+        tenant: str = "default",
         weights_version: int | None = None,
     ) -> str | None:
         """Terminal bookkeeping for a request that was recovered ALREADY
@@ -2028,6 +2063,7 @@ class ServeEngine:
         )
         req.generated = [int(t) for t in generated]
         req.finish_reason = reason
+        req.tenant = tenant
         if trace_id is not None:
             req.extra["trace"] = trace_id
         req.extra["weights_version"] = int(
@@ -2045,6 +2081,11 @@ class ServeEngine:
             self.metrics.on_abort(req)
         else:
             self.metrics.on_finish(req)
+        if self.tenants is not None:
+            # the tenant's bill survives the crash too: the recovered
+            # terminal charges whatever cost fields the replay carried
+            # (usually zero — the device time died with the old process)
+            self.tenants.on_terminal(req)
         # the canonical log still gets its line (phases empty — the
         # timestamps died with the old process; the SLO verdict reports
         # it untimed rather than guessing)
@@ -2110,6 +2151,9 @@ class ServeEngine:
                 else self.weights_version
             ),
             host_tier=self.host_tier,
+            # the ledger rides the rebuild like metrics: a restart is the
+            # same replica, so tenant bills must keep accumulating
+            tenants=self.tenants,
             spec_k=self.spec_k,
             spec_ngram=self.spec_ngram,
             spec_min_accept=self.spec_min_accept,
@@ -2203,6 +2247,8 @@ class ServeEngine:
         tid = req.extra.get("trace")
         if tid is not None:
             kw["trace"] = tid
+        if req.tenant != "default":
+            kw["tenant"] = req.tenant
         return kw
 
     def _log_request(self, req: Request, reason: str) -> None:
@@ -2316,6 +2362,8 @@ class ServeEngine:
             self._draft_states.pop(req.req_id, None)
             self._flush_detok(req)
             self.metrics.on_finish(req)
+            if self.tenants is not None:
+                self.tenants.on_terminal(req)
             if self.journal is not None:
                 # flush the final delivery delta (the finishing tick's
                 # token would otherwise be missed — the request leaves
@@ -2355,6 +2403,10 @@ class ServeEngine:
         req.finish_time = self.clock()
         self._flush_detok(req)
         self.metrics.on_abort(req)
+        if self.tenants is not None:
+            # aborted work is still billed work: whatever device cost the
+            # request accrued before cancellation lands on its tenant
+            self.tenants.on_terminal(req)
         if self.journal is not None:
             self.journal.end_tick((req,))
             self.journal.terminal(req.req_id, "aborted")
@@ -2376,6 +2428,25 @@ class ServeEngine:
         ]
         for rid in expired:
             self.abort(rid)
+
+    def _fair_prefill_order(self, running: list[Request]) -> list[Request]:
+        """Fair-share prefill ordering (``--tenant-fairness``): rank the
+        running list by each tenant's accumulated cost share — terminal
+        charges plus live work-so-far, byte-based when the telemetry
+        roofline is attached, token-based otherwise — so the tick's
+        prefill budget fills smallest-share-first.  The sort is STABLE
+        over the scheduler's admission-ordered running list, so within a
+        tenant requests stay oldest-first, and with one tenant (or the
+        hook off) every key ties and the order is byte-identical to
+        fairness-off.  Decode rows are untouched: ``plan_tick`` only
+        consults this for the prefill fill, so running decodes are never
+        starved by a cheaper tenant's arrivals."""
+        if self.tenants is None:
+            return running
+        share = self.tenants.cost_shares(
+            running, use_bytes=self.telemetry is not None,
+        )
+        return sorted(running, key=lambda r: share.get(r.tenant, 0.0))
 
     # ------------------------------------------------------------------
     def _prefill_request(self, req: Request) -> None:
@@ -2934,7 +3005,12 @@ class ServeEngine:
         t2 = self.tracer.now_us() if self.tracer is not None else -1.0
 
         decode_rows, prefill_segs = self.scheduler.plan_tick(
-            self._tick_budget(), self.prefill_chunk
+            self._tick_budget(), self.prefill_chunk,
+            prefill_order=(
+                self._fair_prefill_order
+                if self.tenants is not None and self.tenants.fairness
+                else None
+            ),
         )
         t3 = self.tracer.now_us() if self.tracer is not None else -1.0
 
@@ -3358,6 +3434,8 @@ class ServeEngine:
         # spill into (or restore from) the shared host pool, and its
         # wall times must not seed the breakeven's prefill rate
         host_tier, self.host_tier = self.host_tier, None
+        # ...and the tenant ledger: the dummy request is nobody's bill
+        tenants, self.tenants = self.tenants, None
         # the SLO tracker is suspended the same way (the dummy request
         # must not count as a verdict) and survives _warmup_body's
         # metrics reset — the fresh ServeMetrics gets it back
@@ -3372,6 +3450,7 @@ class ServeEngine:
             self.request_log = request_log
             self.telemetry = telemetry
             self.host_tier = host_tier
+            self.tenants = tenants
             self.metrics.slo = slo_tracker
 
     def _warmup_body(self, prompt_lens: list[int],

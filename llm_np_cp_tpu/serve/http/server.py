@@ -73,7 +73,7 @@ from llm_np_cp_tpu.serve.http.protocol import (
 )
 from llm_np_cp_tpu.serve.http.sse import DONE_SENTINEL, sse_event
 from llm_np_cp_tpu.serve.metrics import ServeMetrics
-from llm_np_cp_tpu.serve.scheduler import QueueFull
+from llm_np_cp_tpu.serve.scheduler import QueueFull, TenantThrottled
 from llm_np_cp_tpu.serve.tracing import (
     gen_trace_id,
     make_traceparent,
@@ -316,6 +316,7 @@ class EngineRunner:
                     "drains": int(rec.get("drains", 0)),
                 },
                 speculative=bool(rec.get("spec", False)),
+                tenant=rec.get("tenant", "default"),
                 weights_version=rec.get("wv"),
             )
         except Exception as e:  # noqa: BLE001 — per-request fate
@@ -354,6 +355,7 @@ class EngineRunner:
                 "replays": int(rec.get("replays", 0)) + 1,
                 "drains": int(rec.get("drains", 0)),
             },
+            tenant=rec.get("tenant", "default"),
             weights_version=rec.get("wv"),
         )
         if rid in self._live:
@@ -577,7 +579,13 @@ class EngineRunner:
                     on_event=on_event, deadline_s=deadline,
                     trace_id=getattr(payload, "trace_id", None),
                     speculative=getattr(payload, "speculative", False),
+                    tenant=getattr(payload, "tenant", "default"),
                 )
+            except TenantThrottled as e:
+                # same 429 + Retry-After contract as a full queue, but
+                # the message names the tenant's cap, not the queue
+                self._push(rid, ("rejected", 1, str(e)))
+                self._live.pop(rid, None)
             except QueueFull:
                 self._push(rid, ("rejected", 1))
                 self._live.pop(rid, None)
@@ -613,6 +621,10 @@ class EngineRunner:
                     # restart replay or a drain-to-peer keeps reporting
                     # it, whatever weights the adopting engine runs
                     "wv": int(req.extra.get("weights_version", 0)),
+                    # the tenant rides the recovery record too: a
+                    # restart replay or drain-to-peer re-admits under
+                    # the tenant that submitted the stream
+                    "tenant": getattr(payload, "tenant", "default"),
                     "tokens": [],
                     # parallel text deltas, so a Last-Event-ID resume
                     # replays the exact text the stream would have
@@ -863,6 +875,10 @@ class EngineRunner:
         # yanked pool's garbage into the shared host store, nor its
         # wall times pollute the breakeven measurements
         old.host_tier = None
+        # ...and the tenant ledger: the clone shares the REAL ledger
+        # (bills survive the restart); a zombie's stale terminals must
+        # not double-charge a tenant the rebuilt engine re-runs
+        old.tenants = None
         with self._sup_lock:
             if gen != self._gen:
                 # superseded DURING the rebuild (it wedged long enough
@@ -1289,6 +1305,8 @@ class HttpServer:
             )
         elif method == "GET" and path == "/debug/slo":
             await self._respond_slo(writer)
+        elif method == "GET" and path == "/debug/tenants":
+            await self._respond_tenants(writer)
         elif method == "GET" and path == "/debug/trace":
             tracer = self.tracer
             if tracer is None:
@@ -1417,7 +1435,7 @@ class HttpServer:
         faults = self.runner.faults
         recov = self.runner.recovery_latency_s
         wv = getattr(engine, "weights_version", 0)
-        return engine.metrics.prometheus(
+        text = engine.metrics.prometheus(
             # the version label appears once an upgrade rolled (wv > 0)
             # — pre-upgrade series keep their exact labelsets
             const_labels={"version": str(wv)} if wv else None,
@@ -1444,6 +1462,14 @@ class HttpServer:
             ),
             **journal_gauges,
         })
+        tenants = getattr(engine, "tenants", None)
+        if tenants is not None:
+            # tenant-labeled series (serve/tenants.py) ride the same
+            # scrape; the ledger bounds its own label cardinality
+            text += tenants.prometheus(
+                const_labels={"version": str(wv)} if wv else None,
+            )
+        return text
 
     async def _respond_slo(self, writer: asyncio.StreamWriter) -> None:
         """``GET /debug/slo``: the fleet's SLO accounting as one JSON —
@@ -1466,6 +1492,31 @@ class HttpServer:
         if replicas is not None:
             body["replicas"] = [
                 t.snapshot() if t is not None else None for t in trackers
+            ]
+        await self._respond(writer, 200, json.dumps(body).encode())
+
+    async def _respond_tenants(self, writer: asyncio.StreamWriter) -> None:
+        """``GET /debug/tenants``: the fleet's per-tenant accounting as
+        one JSON — requests, tokens, device-cost attribution, SLO
+        detail, throttles — summed across replicas with a per-replica
+        breakdown.  404 + hint when no ledger is attached (the
+        ``/debug/slo`` discipline)."""
+        from llm_np_cp_tpu.serve.tenants import aggregate_tenants
+
+        replicas = getattr(self.runner, "replicas", None)
+        runners = replicas if replicas is not None else [self.runner]
+        ledgers = [
+            getattr(r.engine, "tenants", None) for r in runners
+        ]
+        if not any(t is not None for t in ledgers):
+            await self._respond_error(writer, HTTPError(
+                404, "tenant accounting is off; start the server with "
+                "--tenants"))
+            return
+        body = aggregate_tenants(ledgers)
+        if replicas is not None:
+            body["replicas"] = [
+                t.snapshot() if t is not None else None for t in ledgers
             ]
         await self._respond(writer, 200, json.dumps(body).encode())
 
@@ -1652,6 +1703,7 @@ class HttpServer:
                 body, model_id=self.model_id, tokenizer=self.tokenizer,
                 default_max_tokens=self.default_max_tokens,
                 max_tokens_cap=self.max_tokens_cap,
+                header_tenant=headers.get("x-tenant-id"),
             )
         except HTTPError as e:
             await self._respond_error(writer, e)
@@ -1690,8 +1742,10 @@ class HttpServer:
         self.runner.submit(rid, payload, loop, aq)
         verdict = await aq.get()
         if verdict[0] == "rejected":
+            msg = (verdict[2] + "; retry later" if len(verdict) > 2
+                   else "request queue is full; retry later")
             await self._respond_error(writer, HTTPError(
-                429, "request queue is full; retry later",
+                429, msg,
                 etype="rate_limit_error",
                 headers=(("Retry-After", str(verdict[1])),),
             ))

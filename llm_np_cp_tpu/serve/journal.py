@@ -148,6 +148,10 @@ def _apply(state: dict[int, dict], rec: dict) -> int | None:
             # replay (possibly onto a rolled engine) keeps reporting
             # the version that actually served the stream
             "wv": int(rec.get("wv", 0)),
+            # tenancy survives kill -9: the replay re-admits under the
+            # tenant that submitted it, so the bill lands on the right
+            # ledger row after the crash too
+            "tenant": rec.get("tenant", "default"),
         }
     elif t == "wm":
         for rid, n, toks in rec["rows"]:
@@ -308,6 +312,11 @@ class RequestJournal:
         wv = req.extra.get("weights_version")
         if wv:
             rec["wv"] = int(wv)
+        # tenant id: written only when non-default, so single-tenant
+        # journals stay byte-stable across the tenancy feature
+        tenant = getattr(req, "tenant", "default")
+        if tenant != "default":
+            rec["tenant"] = tenant
         self._enqueue(rec)
         if self.sync_admissions:
             # block the enqueuing (engine) thread until the writer has
@@ -479,6 +488,8 @@ class RequestJournal:
                         rec["spec"] = True
                     if ent.get("wv"):
                         rec["wv"] = ent["wv"]
+                    if ent.get("tenant", "default") != "default":
+                        rec["tenant"] = ent["tenant"]
                     f.write(_frame(rec))
                 f.flush()
                 if self.fsync:

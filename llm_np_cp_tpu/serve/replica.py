@@ -82,6 +82,17 @@ def _fresh_replica_engine(src: Any) -> Any:
             warmup_ticks=sent.warmup_ticks, min_us=sent.min_us,
         )
     eng.actions = None if src.actions is None else src.actions.spawn()
+    ledger = getattr(src, "tenants", None)
+    if ledger is not None:
+        # share-nothing here too: each replica bills its own ledger
+        # (same config), and the scrape/debug endpoints aggregate
+        from llm_np_cp_tpu.serve.tenants import TenantLedger
+
+        eng.tenants = TenantLedger(
+            fairness=ledger.fairness, max_inflight=ledger.max_inflight,
+            max_series=ledger.max_series, policy=ledger.policy,
+            clock=ledger.clock,
+        )
     return eng
 
 
@@ -288,6 +299,7 @@ class ReplicaSet:
                arrival_time: float | None = None,
                trace_id: str | None = None,
                speculative: bool = False,
+               tenant: str = "default",
                replica: int | None = None) -> Request:
         """Route (or pin, via ``replica=``) and submit.  The returned
         Request carries its replica in ``extra['replica']`` and the
@@ -308,7 +320,7 @@ class ReplicaSet:
             prompt_ids, max_new_tokens, request_id=rid, seed=seed,
             callback=callback, on_event=on_event, deadline_s=deadline_s,
             arrival_time=arrival_time, trace_id=trace_id,
-            speculative=speculative,
+            speculative=speculative, tenant=tenant,
         )
         if spilled:
             req.extra["spilled"] = True
@@ -506,6 +518,7 @@ class ReplicaSet:
                 request_id=req.req_id, generated=tokens,
                 reason=reason,
                 trace_id=req.extra.get("trace"), lineage=lineage,
+                tenant=getattr(req, "tenant", "default"),
                 weights_version=wv,
             )
             req.finish_reason = reason
@@ -520,7 +533,9 @@ class ReplicaSet:
                 generated=tokens, callback=req.callback,
                 on_event=req.on_event, deadline_at=req.deadline,
                 trace_id=req.extra.get("trace"), lineage=lineage,
-                speculative=req.speculative, weights_version=wv,
+                speculative=req.speculative,
+                tenant=getattr(req, "tenant", "default"),
+                weights_version=wv,
             )
 
     def _replay_in_place(self, old: Any, engine: Any) -> int:
@@ -748,6 +763,17 @@ class ReplicaSet:
             [getattr(e.metrics, "slo", None) for e in self.engines]
         )
         out.update({k: v for k, v in agg.items() if k != "policy"})
+        # fleet tenant accounting: per-tenant counters summed across
+        # replica ledgers, cost shares and SLO burn recomputed from the
+        # sums (serve/tenants.aggregate_tenants)
+        from llm_np_cp_tpu.serve.tenants import aggregate_tenants
+
+        tn = aggregate_tenants(
+            [getattr(e, "tenants", None) for e in self.engines]
+        )
+        if tn:
+            out["tenants"] = tn["tenants"]
+            out["n_tenants"] = tn["n_tenants"]
         return out
 
     # ------------------------------------------------------------------
@@ -1327,6 +1353,12 @@ class ReplicaRunner:
                 extra_gauges=per_gauges,
                 const_labels=const,
             )
+            ledger = getattr(engine, "tenants", None)
+            if ledger is not None:
+                # tenant-labeled series carry the same replica/version
+                # const labels; the seen_meta dedup below collapses the
+                # repeated HELP/TYPE headers across replicas
+                text += ledger.prometheus(const_labels=const)
             lines = []
             for line in text.splitlines():
                 if line.startswith("#"):

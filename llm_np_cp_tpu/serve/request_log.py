@@ -7,7 +7,8 @@ engine emits one wide-event JSON line carrying everything forensics
 needs in one place:
 
 - identity      — ``rid``, the W3C ``trace`` id (the SAME id across
-  replicas, restarts, and drains), wall ``ts``;
+  replicas, restarts, and drains), wall ``ts``, and the normalized
+  ``tenant`` (serve/tenants.py; written only when non-default);
 - routing       — ``replica``, whether the router ``spilled`` it off
   its prefix-affine replica, and the ``weights_version`` that admitted
   (and serves) the request — ONE version per line, drains included;
@@ -76,6 +77,12 @@ def request_record(
         "replays": int(extra.get("replays", 0)),
         "drains": int(extra.get("drains", 0)),
     }
+    tenant = getattr(req, "tenant", "default")
+    if tenant != "default":
+        # written only when non-default, so single-tenant logs stay
+        # byte-stable across the tenancy feature; the id is already
+        # normalized (charset-whitelisted) at the protocol boundary
+        rec["tenant"] = tenant
     phases: dict[str, float] = {}
     if req.submit_time is not None:
         if req.admit_time is not None:

@@ -71,6 +71,26 @@ class QueueFull(RuntimeError):
         self.cap = cap
 
 
+class TenantThrottled(QueueFull):
+    """Admission rejected by the per-tenant in-flight cap
+    (``--tenant-max-inflight``).  A ``QueueFull`` subclass so every
+    existing "try again later" handler (HTTP 429 + Retry-After) applies
+    unchanged; carries the tenant for the throttle counter and trace
+    instant."""
+
+    def __init__(self, tenant: str, inflight: int, cap: int) -> None:
+        # bypass QueueFull.__init__: the message names the TENANT's
+        # live count, not the queue depth
+        RuntimeError.__init__(
+            self,
+            f"tenant {tenant!r} is at its in-flight cap "
+            f"({inflight} live, cap {cap})"
+        )
+        self.tenant = tenant
+        self.depth = inflight
+        self.cap = cap
+
+
 @dataclasses.dataclass
 class Request:
     """One generation request and its serving-side bookkeeping."""
@@ -114,6 +134,11 @@ class Request:
     # opt-in flag (per-request `"speculative": true` over HTTP); the
     # engine only drafts for it when built with spec_k > 0
     speculative: bool = False
+    # -- multi-tenancy (serve/tenants.py) -----------------------------
+    # normalized tenant id (X-Tenant-Id header / "tenant" body field;
+    # absent → "default"), carried through journal replay, drain, and
+    # every observability surface
+    tenant: str = "default"
     # draft tokens packed for THIS tick's verify lane (set by the
     # engine's draft pass, trimmed by plan_tick's budget, consumed by
     # the accept walk; always 0 between ticks).  Growth covers
@@ -276,7 +301,9 @@ class Scheduler:
 
     # ------------------------------------------------------------------
     def plan_tick(
-        self, budget: int, max_chunk: int,
+        self, budget: int, max_chunk: int, *,
+        prefill_order: Callable[
+            [list[Request]], list[Request]] | None = None,
     ) -> tuple[list[Request], list[tuple[Request, int]]]:
         """The unified-tick token-budget planner: split this tick's
         ``budget`` tokens between decode rows and prefill chunk slices.
@@ -293,6 +320,10 @@ class Scheduler:
           up to ``max_chunk`` tokens each from what is left.  Token
           granularity: a segment smaller than a full chunk is legal, so
           any ``budget >= max_slots`` guarantees forward progress.
+          ``prefill_order`` overrides the candidate ORDER only (the
+          tenant-fairness hook — smallest cost share first, a stable
+          re-sort so ties keep admission order); ``None`` is the
+          byte-identical oldest-first default.
         - **budgets are exact**: the planned token count never exceeds
           ``budget`` (pinned by tests/test_serve_scheduler.py).
         - **prefix-cache hits are free**: covered content was pre-marked
@@ -311,7 +342,11 @@ class Scheduler:
         decode = [r for r in self.running if r.prefilled and r.generated]
         left = budget - len(decode)
         prefill: list[tuple[Request, int]] = []
-        for r in self.running:
+        candidates = (
+            self.running if prefill_order is None
+            else prefill_order(self.running)
+        )
+        for r in candidates:
             if r.prefilled or left <= 0:
                 continue
             n = min(max_chunk, r.prefill_target - r.prefill_done, left)

@@ -57,6 +57,7 @@ def replay_arrivals(
                 callback=item.get("callback"),
                 arrival_time=item["arrival_s"],
                 speculative=item.get("speculative", False),
+                tenant=item.get("tenant", "default"),
             )
             if realtime:
                 # wall arrival: TTFT then counts the wait between

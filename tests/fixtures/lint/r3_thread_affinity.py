@@ -25,6 +25,7 @@ LINT_THREAD_DOMAINS = {
 LINT_LOCKED_STATE = {
     "Counters": {"lock": "_lock", "attrs": ["ttft_s", "n_finished"]},
     "Policy": {"lock": "_lock", "attrs": ["shed_load"]},
+    "Ledger": {"lock": "_lock", "attrs": ["_tenants"]},
 }
 
 
@@ -99,6 +100,17 @@ class Policy:
         self.shed_load = True  # BITE verdict state outside the policy lock
         with self._lock:
             self.shed_load = False  # under the lock: NOT a finding
+
+
+class Ledger:
+    def on_terminal(self, req):
+        self._tenants[req.tenant] = {}  # BITE tenant counters outside the ledger lock
+        with self._lock:
+            self._tenants[req.tenant] = {}  # under the lock: NOT a finding
+
+    def snapshot(self):
+        with self._lock:
+            return dict(self._tenants)  # locked read: NOT a finding
 
 
 class Counters:

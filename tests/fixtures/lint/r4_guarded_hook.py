@@ -26,6 +26,9 @@ class Engine:
     def plan_unguarded_host_tier(self, keys):
         return self.host_tier.match(keys)  # BITE host_tier hook unguarded
 
+    def finish_unguarded_tenants(self, req):
+        self.tenants.on_terminal(req)  # BITE tenants ledger unguarded
+
     def step_guarded(self):
         if self.tracer is not None:
             self.tracer.instant("tick")  # guarded: NOT a finding

@@ -89,6 +89,7 @@ def test_priority_matches_config_dicts():
         + list(bench.SERVE_RESTART_CONFIGS)
         + list(bench.SERVE_ROLLING_CONFIGS)
         + list(bench.SERVE_TIER_CONFIGS)
+        + list(bench.SERVE_TENANT_CONFIGS)
         if not n.startswith("smoke")
     }
     assert set(bench.PRIORITY) == non_smoke | bench.EXTRA_CHILDREN
@@ -110,7 +111,8 @@ def test_warm_smoke_offline():
                                  and n not in bench.SERVE_SHARDED_CONFIGS
                                  and n not in bench.SERVE_RESTART_CONFIGS
                                  and n not in bench.SERVE_ROLLING_CONFIGS
-                                 and n not in bench.SERVE_TIER_CONFIGS}
+                                 and n not in bench.SERVE_TIER_CONFIGS
+                                 and n not in bench.SERVE_TENANT_CONFIGS}
 
 
 def test_warm_limit_covers_top_priority_only():
@@ -249,6 +251,40 @@ def test_serve_tier_smoke_offline():
     # slo_gate-compatible summary fields on both legs
     for leg in legs.values():
         assert "goodput_tok_s" in leg and "slo_attainment" in leg
+
+
+def test_serve_tenant_smoke_offline():
+    """The multi-tenant fairness child: three skewed-rate per-tenant
+    Poisson processes merged into one arrival schedule, replayed
+    fairness-off vs fairness-on — per-tenant attainment/goodput/cost
+    share from the TenantLedger on both legs, token parity (fairness
+    reorders prefill scheduling, never content), and zero compiles
+    added by either leg (ordering is host-side)."""
+    res = bench._spawn("smoke_serve_tenant", 600,
+                       env={"BENCH_PLATFORM": "cpu"})
+    assert res.get("ok") is True, res
+    assert res["token_parity_fair_vs_off"] is True
+    assert res["compiles_added_by_fairness"] == 0
+    legs = res["legs"]
+    mix = res["tenant_mix"]
+    assert set(mix) == {"chat", "complete", "batch"}
+    for leg in legs.values():
+        assert leg["compiles_added_by_trace"] == 0
+        tenants = leg["tenants"]
+        # every configured tenant accounted, request counts conserved
+        assert set(tenants) == set(mix)
+        for t, d in tenants.items():
+            assert d["requests"] == mix[t]["requests"]
+            assert d["tokens"] > 0
+            assert 0.0 <= d["cost_share"] <= 1.0
+            # the slo_gate --min-tenant-attainment inputs are present
+            assert d["slo_attainment"] is not None
+            assert d["goodput_tok_s"] >= 0
+        assert abs(sum(d["cost_share"] for d in tenants.values())
+                   - 1.0) < 1e-3
+    # the headline pair slo_gate reads
+    assert res["worst_tenant_attainment"] is not None
+    assert res["worst_tenant_attainment_off"] is not None
 
 
 def test_serve_sharded_smoke_offline():

@@ -87,6 +87,34 @@ def test_rule_bites_its_fixture(rule_id):
     )
 
 
+def test_r4_no_cache_covers_tenants(tmp_path):
+    """The supervisor zombie-mute discipline extends to the tenant
+    ledger: engine tick code must re-read ``self.tenants`` at every
+    hook, never bind it to a local — a cached ref on a zombie engine
+    would keep billing tenants after the supervisor muted it.  The
+    construction/clone/warmup exemptions still apply."""
+    eng_dir = tmp_path / "serve"
+    eng_dir.mkdir()
+    bad = eng_dir / "engine.py"
+    bad.write_text(
+        "class ServeEngine:\n"
+        "    def _tick(self):\n"
+        "        ledger = self.tenants\n"
+        "        if ledger is not None:\n"
+        "            ledger.on_terminal(None)\n"
+        "    def clone_fresh(self):\n"
+        "        ledger = self.tenants\n"
+        "        return ledger\n"
+    )
+    findings = RULES["R4"].check(SourceFile(bad, bad.read_text()))
+    cached = [f for f in findings
+              if "self.tenants cached" in f.message]
+    assert [f.line for f in cached] == [3], findings
+    # the clone_fresh binding (line 7) is exempt: cloning legitimately
+    # carries the ledger to the rebuilt engine
+    assert all(f.line != 7 for f in findings), findings
+
+
 # ---------------------------------------------------------------------------
 # Suppressions
 # ---------------------------------------------------------------------------

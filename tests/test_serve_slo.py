@@ -451,6 +451,58 @@ def test_slo_gate_pass_fail_and_missing(tmp_path):
     assert gate([str(all_nan), "--config", "x"]) == 2
 
 
+def test_slo_gate_min_tenant_attainment(tmp_path):
+    """--min-tenant-attainment gates on the WORST tenant, reads the
+    fairness-ON leg of a serve_tenant_poisson record (gating the best
+    leg would hide a fairness regression), accepts both attainment
+    spellings, and treats missing per-tenant detail as a usage error
+    rather than a silent pass."""
+    from tools.slo_gate import main as gate
+
+    rec = {
+        "config": "serve_tenant_poisson",
+        "slo_attainment": 0.99, "goodput_tok_s": 100.0,
+        "legs": {
+            # fairness-off leg is healthier — the gate must NOT use it
+            "fair_off": {"tenants": {
+                "chat": {"slo_attainment": 0.99},
+                "batch": {"slo_attainment": 0.99},
+            }},
+            "fair_on": {"tenants": {
+                "chat": {"slo_attainment": 0.9},
+                # the nested spelling the TenantLedger snapshot emits
+                "batch": {"slo": {"slo_attainment": 0.95}},
+            }},
+        },
+    }
+    bench = tmp_path / "bench.json"
+    bench.write_text(json.dumps(
+        {"detail": {"serve_tenant_poisson": rec}}))
+    ok = ["--config", "serve_tenant_poisson"]
+    # worst tenant of the fairness-on leg is chat at 0.9
+    assert gate([str(bench), *ok,
+                 "--min-tenant-attainment", "0.85"]) == 0
+    assert gate([str(bench), *ok,
+                 "--min-tenant-attainment", "0.95"]) == 1
+    # an aggregate that looks healthy while one tenant starves fails
+    # even when --min-attainment alone would pass
+    assert gate([str(bench), *ok, "--min-attainment", "0.95",
+                 "--min-tenant-attainment", "0.95"]) == 1
+    # top-level tenants dict (a /debug/tenants-shaped capture) wins
+    top = dict(rec, tenants={
+        "solo": {"slo": {"slo_attainment": 0.7}},
+    })
+    b2 = tmp_path / "top.json"
+    b2.write_text(json.dumps(top))
+    assert gate([str(b2), *ok, "--min-tenant-attainment", "0.6"]) == 0
+    assert gate([str(b2), *ok, "--min-tenant-attainment", "0.8"]) == 1
+    # no per-tenant detail anywhere → usage error, not a silent pass
+    bare = tmp_path / "bare.json"
+    bare.write_text(json.dumps({"config": "x", "slo_attainment": 0.99}))
+    assert gate([str(bare), "--config", "x",
+                 "--min-tenant-attainment", "0.5"]) == 2
+
+
 # ---------------------------------------------------------------------------
 # The acceptance scenario: fleet kill mid-decode → drained streams,
 # one connected merged trace, request-log lines recording the drain
@@ -604,3 +656,69 @@ def test_summarize_trace_merge_cli(tmp_path, capsys):
     # single-file mode still prints the classic summary
     single = st_main([pa])
     assert "== tick phases ==" in single
+
+
+def test_summarize_trace_tenants_section(tmp_path, capsys):
+    """``--request-log`` joins the canonical wide-event lines into a
+    per-tenant breakdown.  The fixture is RECORDED through the real
+    pipeline — request_record() → RequestLog writer thread → JSONL on
+    disk — so the section is pinned against the actual on-disk format,
+    including the written-only-when-non-default tenant convention and
+    the rounded cost dict."""
+    from tools.summarize_trace import main as st_main
+    from tools.summarize_trace import load_request_log, tenant_table
+
+    def _costed(rid, tenant, reason, new_tokens, kv_read):
+        req = _req(rid, submit=0.0, admit=0.1, first=0.4, finish=1.0,
+                   generated=list(range(new_tokens)), reason=reason)
+        req.tenant = tenant
+        req.kv_bytes_read = float(kv_read)
+        req.kv_bytes_written = 512.0
+        req.weight_bytes_amortized = 2048.0
+        req.device_time_s = 0.25
+        return req
+
+    log_path = str(tmp_path / "reqs.jsonl")
+    rlog = RequestLog(log_path)
+    rlog.emit(request_record(_costed(1, "acme", "stop", 4, 4096.0),
+                             reason="stop"))
+    rlog.emit(request_record(_costed(2, "acme", "length", 2, 4096.0),
+                             reason="length"))
+    rlog.emit(request_record(_costed(3, "beta", "stop", 3, 1024.0),
+                             reason="stop"))
+    # a pre-tenancy line: no tenant key, no cost fields → "default"
+    rlog.emit(request_record(_req(4, submit=0.0, finish=0.5,
+                                  generated=[7], reason="aborted"),
+                             reason="aborted"))
+    assert rlog.flush(5.0)
+    rlog.close()
+
+    records = load_request_log(log_path)
+    assert len(records) == 4
+    # the non-default convention survived the round-trip
+    assert "tenant" not in records[3]
+    table = tenant_table(records)
+    assert set(table) == {"acme", "beta", "default"}
+    assert table["acme"]["requests"] == 2
+    assert table["acme"]["new_tokens"] == 6
+    assert table["acme"]["reasons"] == {"stop": 1, "length": 1}
+    assert table["beta"]["requests"] == 1
+    assert table["default"]["reasons"] == {"aborted": 1}
+    # cost shares: acme read 2x4096 vs beta's 1024, default billed zero
+    assert table["acme"]["cost_share"] > table["beta"]["cost_share"] > 0
+    assert table["default"]["cost_share"] == 0.0
+    assert abs(sum(e["cost_share"] for e in table.values()) - 1.0) < 1e-9
+    assert table["acme"]["device_time_s"] == pytest.approx(0.5)
+
+    # the CLI section rides the classic summary, worst-billed first
+    tr = TraceRecorder()
+    tr.request_phase(1, "queued", args={"trace": gen_trace_id()})
+    trace_path = str(tmp_path / "t.json")
+    tr.dump(trace_path)
+    out = st_main([trace_path, "--request-log", log_path])
+    assert "== tenants: 3 from 4 request-log lines ==" in out
+    body = out[out.index("== tenants:"):]
+    assert body.index("acme") < body.index("beta") < body.index("default")
+    assert "stop=1" in body and "length=1" in body and "aborted=1" in body
+    # without the flag the section stays off the classic summary
+    assert "== tenants:" not in st_main([trace_path])

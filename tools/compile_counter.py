@@ -732,6 +732,73 @@ def _self_check() -> None:
     print(f"compile counts OK (rolling upgrade): "
           f"{fleet.engines[0].compile_counts()}")
 
+    # multi-tenant accounting (serve/tenants.py): the ledger is
+    # host-side dict arithmetic fed at terminals, the fairness reorder
+    # is a host-side sort feeding plan_tick, and throttling raises
+    # before anything touches the device — so tenant churn (many
+    # tenants, fairness on, per-tenant caps rejecting admissions)
+    # must compile NOTHING after the warmed ladder, and clone_fresh
+    # must CARRY the ledger (a supervised restart is the same replica,
+    # so its bill keeps accumulating) while sharing the compiled step
+    from llm_np_cp_tpu.serve.scheduler import TenantThrottled
+    from llm_np_cp_tpu.serve.slo import SLOPolicy
+    from llm_np_cp_tpu.serve.tenants import TenantLedger
+
+    ledger = TenantLedger(
+        fairness=True, max_inflight=2,
+        policy=SLOPolicy(ttft_s=60.0, tpot_s=60.0),
+    )
+    eng = ServeEngine(
+        params, cfg, sampler=Sampler(kind="greedy"), max_slots=2,
+        num_blocks=32, block_size=8, max_seq_len=64,
+        cache_dtype=jnp.float32, mixed_step="on", tenants=ledger,
+    )
+    ten_prompts = [rng.integers(1, 200, size=n) for n in (19, 7, 11)]
+    eng.warmup([int(p.size) for p in ten_prompts], max_new_tokens=8)
+    warm = dict(eng.compile_counts())
+    throttled = 0
+    with CompileCounter().watch() as counter:
+        for rep in range(3):
+            for i, p in enumerate(ten_prompts):
+                for tenant in (f"team-{i}", f"team-{i}", "burst"):
+                    try:
+                        eng.submit(p, 4 + i, tenant=tenant)
+                    except TenantThrottled:
+                        throttled += 1  # the cap's 429 path, on purpose
+            eng.run_until_complete()
+    assert counter.count == 0, (
+        f"tenant churn + throttling compiled: {counter.events}"
+    )
+    assert eng.compile_counts() == warm
+    tsnap = ledger.snapshot()
+    assert tsnap["n_tenants"] >= 3, "tenant churn metered nothing"
+    assert throttled > 0 or any(
+        e["throttled"] for e in tsnap["tenants"].values()
+    ), "the per-tenant cap never bit — bad self-check workload"
+    live = [eng.submit(p, 6, tenant="survivor") for p in ten_prompts[:2]]
+    eng.step()
+    rebuilt = eng.clone_fresh()
+    assert rebuilt.tenants is ledger, "clone_fresh dropped the ledger"
+    assert rebuilt._mixed_step is eng._mixed_step
+    with CompileCounter().watch() as counter:
+        for r in live:
+            rebuilt.recover(
+                r.prompt, r.max_new_tokens, request_id=r.req_id,
+                seed=r.seed, generated=list(r.generated),
+                tenant=r.tenant,
+            )
+        rebuilt.run_until_complete()
+    assert counter.count == 0, (
+        f"tenant-billed restart + recovery replay compiled: "
+        f"{counter.events}"
+    )
+    surv = ledger.snapshot()["tenants"].get("survivor")
+    assert surv and surv["requests"] == len(live), (
+        "recovered requests lost their tenant across the rebuild"
+    )
+    print(f"compile counts OK (tenants): {tsnap['n_tenants']} tenants, "
+          f"{throttled} throttled, {eng.compile_counts()}")
+
 
 if __name__ == "__main__":
     _self_check()

@@ -9,8 +9,9 @@ detector, the ``slo`` goodput tracker (serve/slo.py), the
 ``actions`` lifecycle auto-action policy (serve/lifecycle.py), the
 ``telemetry`` device roofline model (serve/telemetry.TelemetryModel),
 the ``otel`` OTLP span sink (serve/otel.OtlpExporter, hung off the
-TraceRecorder) and the ``host_tier`` host-RAM KV block tier
-(serve/host_tier.HostTier) — are OFF by
+TraceRecorder), the ``host_tier`` host-RAM KV block tier
+(serve/host_tier.HostTier) and the ``tenants`` multi-tenant ledger
+(serve/tenants.TenantLedger) — are OFF by
 default, spelled as ``None`` attributes.  The zero-overhead contract is that every hook call sits
 behind an ``is None`` / ``is not None`` check in the same function, so
 instruments-off costs an attribute load and a branch: no dict built for
@@ -45,7 +46,7 @@ from tools.lint.core import (
 RULE_ID = "R4"
 
 HOOKS = ("tracer", "faults", "journal", "request_log", "sentinel", "slo",
-         "actions", "telemetry", "otel", "host_tier")
+         "actions", "telemetry", "otel", "host_tier", "tenants")
 # engine methods where binding self.tracer/self.metrics/self.journal to
 # a local is fine: construction, cloning, and the warmup
 # suspend/restore swap — none of them run inside a supervised tick
@@ -170,7 +171,7 @@ class _Rule:
                     continue
                 if chain[1] not in ("tracer", "metrics", "journal",
                                     "request_log", "actions",
-                                    "telemetry", "host_tier"):
+                                    "telemetry", "host_tier", "tenants"):
                     continue
                 if not any(isinstance(t, ast.Name) for t in node.targets):
                     continue

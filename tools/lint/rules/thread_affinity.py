@@ -100,6 +100,10 @@ DOMAIN_TABLE: tuple[tuple[str, str, str], ...] = (
     ("serve/lifecycle.py", "LifecycleController.*", "lifecycle"),
     ("serve/lifecycle.py", "*", "engine"),
     ("serve/metrics.py", "*", "shared"),
+    # the tenant ledger (serve/tenants.py) is metrics-shaped shared
+    # state: the engine tick thread folds terminals in, the scrape and
+    # /debug/tenants endpoints read from the asyncio thread
+    ("serve/tenants.py", "*", "shared"),
     ("serve/tracing.py", "*", "shared"),
     ("serve/faults.py", "*", "shared"),
     ("serve/*.py", "*", "engine"),
@@ -285,6 +289,16 @@ LOCK_STATE: tuple[dict, ...] = (
                   "restore_s_per_block", "restore_gbps",
                   "prefill_tok_s", "_probed_bytes"},
         "lock_assumed": set(),
+    },
+    {
+        # the tenant ledger's engine↔scrape boundary: per-tenant
+        # counter maps and the lazy SLO tracker map are the shared
+        # state; every mutation takes the ledger's lock
+        "file": "serve/tenants.py",
+        "class": "TenantLedger",
+        "lock": "_lock",
+        "attrs": {"_tenants", "_slo"},
+        "lock_assumed": {"_entry"},
     },
     {
         # the sentinel/tracker → ActionPolicy signal flow: the engine

@@ -26,6 +26,11 @@ Checks (each only when its flag/keys are present):
   streams and its p99 TTFT must stay within R× the steady leg's
   (``ttft_p99_degradation`` recorded by the bench, or recomputed from
   ``legs.{steady,rolling}.ttft_s_p99``).
+- ``--min-tenant-attainment X`` — multi-tenant mode, consuming the
+  per-tenant detail recorded by ``serve_tenant_poisson`` (a
+  ``tenants`` dict, top-level or per leg): the WORST tenant's
+  ``slo_attainment`` must be >= X — an aggregate that looks healthy
+  while one tenant starves fails the build.
 - ``--baseline OLD.json``       — compare against an older capture:
   ``--max-attainment-drop D`` (absolute) and ``--max-goodput-drop R``
   (fractional, 0.1 = 10%).
@@ -156,6 +161,72 @@ def _gate_rolling(rec: dict, nums: dict[str, float], max_deg: float,
     return None
 
 
+def _gate_tenants(rec: dict, nums: dict[str, float], min_att: float,
+                  failures: list[str]) -> int | None:
+    """The multi-tenant gate: the WORST tenant's attainment must clear
+    the floor.  Per-tenant detail is a ``tenants`` dict — top-level or
+    inside any leg (the fairness-ON leg of ``serve_tenant_poisson``
+    gates when legs are present; gating the best leg would hide a
+    fairness regression).  Returns 2 when the record carries no
+    per-tenant detail, None to continue."""
+
+    def _num(v: Any) -> float | None:
+        if isinstance(v, (int, float)) and not isinstance(v, bool) \
+                and not math.isnan(v):
+            return float(v)
+        return None
+
+    def tenant_attainments(d: Any) -> dict[str, float]:
+        out: dict[str, float] = {}
+        if not isinstance(d, dict):
+            return out
+        for tenant, ent in d.items():
+            if not isinstance(ent, dict):
+                continue
+            att = _num(ent.get("slo_attainment"))
+            if att is None and isinstance(ent.get("slo"), dict):
+                att = _num(ent["slo"].get("slo_attainment"))
+            if att is not None:
+                out[str(tenant)] = att
+        return out
+
+    atts = tenant_attainments(rec.get("tenants"))
+    if not atts:
+        legs = rec.get("legs")
+        if isinstance(legs, dict):
+            # prefer the fairness-on leg when one exists — that is the
+            # configuration the gate is protecting; the fairness-OFF
+            # control leg ranks last so it can never mask a regression
+
+            def _leg_rank(name: str) -> int:
+                if "fair" not in name:
+                    return 1
+                return 2 if "off" in name else 0
+
+            ordered = sorted(
+                legs.items(), key=lambda kv: _leg_rank(kv[0]),
+            )
+            for _, leg_rec in ordered:
+                if isinstance(leg_rec, dict):
+                    atts = tenant_attainments(leg_rec.get("tenants"))
+                    if atts:
+                        break
+    if not atts:
+        print("slo-gate: no per-tenant detail (a 'tenants' dict with "
+              "per-tenant slo_attainment) in the record — was this a "
+              "serve_tenant_poisson capture with an SLO policy?",
+              file=sys.stderr)
+        return 2
+    worst_tenant = min(atts, key=lambda t: atts[t])
+    worst = atts[worst_tenant]
+    nums["tenant_attainment_min"] = worst
+    if worst < min_att:
+        _fail(failures,
+              f"tenant {worst_tenant!r} slo_attainment {worst:.4f} < "
+              f"min {min_att} (worst of {len(atts)} tenants)")
+    return None
+
+
 def run_gate(args: argparse.Namespace) -> int:
     try:
         data = json.load(open(args.bench))
@@ -169,7 +240,8 @@ def run_gate(args: argparse.Namespace) -> int:
         return 2
     nums = slo_numbers(rec)
     if not nums and args.max_p99_ttft_degradation is None \
-            and args.min_bandwidth_util is None:
+            and args.min_bandwidth_util is None \
+            and args.min_tenant_attainment is None:
         print(f"slo-gate: {args.bench} carries no SLO numbers "
               "(slo_attainment / goodput_tok_s) — was the bench run "
               "with an SLO policy?", file=sys.stderr)
@@ -178,6 +250,11 @@ def run_gate(args: argparse.Namespace) -> int:
     failures: list[str] = []
     if args.max_p99_ttft_degradation is not None:
         rc = _gate_rolling(rec, nums, args.max_p99_ttft_degradation,
+                           failures)
+        if rc is not None:
+            return rc
+    if args.min_tenant_attainment is not None:
+        rc = _gate_tenants(rec, nums, args.min_tenant_attainment,
                            failures)
         if rc is not None:
             return rc
@@ -281,6 +358,13 @@ def main(argv: list[str] | None = None) -> int:
                    "must stay within R x the steady leg's, and the "
                    "roll must have dropped zero streams (consumes the "
                    "serve_rolling_upgrade bench record)")
+    p.add_argument("--min-tenant-attainment", type=float, default=None,
+                   metavar="X",
+                   help="multi-tenant mode: the WORST tenant's "
+                   "slo_attainment must be >= X (consumes the "
+                   "per-tenant 'tenants' detail recorded by the "
+                   "serve_tenant_poisson bench — top-level, else the "
+                   "fairness leg's)")
     p.add_argument("--baseline", default=None,
                    help="older bench JSON to compare against")
     p.add_argument("--max-attainment-drop", type=float, default=0.05,

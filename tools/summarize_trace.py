@@ -20,7 +20,12 @@ from ``GET /debug/trace``) and prints:
   host-tier tick args;
 - **per-request lifecycle table** — queued / prefill / decode (and, when
   the HTTP layer traced it, the accept→response bracket) per request,
-  with eviction/recovery counts and the finish reason.
+  with eviction/recovery counts and the finish reason;
+- **tenants** (when ``--request-log PATH`` points at the canonical
+  request log for the same run) — per-tenant request / token / cost
+  breakdown joined from the wide-event lines: requests by finish
+  reason, prompt+new tokens, device-cost totals and each tenant's share
+  of the fleet's device cost.
 
 - **merge mode** (``--merge`` / multiple files) — stitch PER-REPLICA or
   per-process trace files into ONE request-ordered timeline.  Each
@@ -37,6 +42,7 @@ from ``GET /debug/trace``) and prints:
 Usage::
 
     python tools/summarize_trace.py TRACE.json [--top K]
+    python tools/summarize_trace.py TRACE.json --request-log REQS.jsonl
     python tools/summarize_trace.py A.json B.json [--merge OUT.json]
 """
 
@@ -325,6 +331,85 @@ def kv_tier(events: list[dict]) -> dict[str, float] | None:
     return out
 
 
+def load_request_log(path: str) -> list[dict]:
+    """Parse a request-log JSONL file (serve/request_log.py), skipping
+    blank and torn lines.  Local copy so this tool stays stdlib-only —
+    pinned equivalent to ``serve.request_log.read_request_log`` by the
+    shared on-disk format (one JSON object per line)."""
+    out: list[dict] = []
+    with open(path, encoding="utf-8") as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                out.append(json.loads(line))
+            except ValueError:
+                continue  # torn tail
+    return out
+
+
+def tenant_table(records: list[dict]) -> dict[str, dict[str, Any]]:
+    """tenant → request/token/cost totals from request-log lines.  The
+    request log writes ``tenant`` only when non-default, so absent maps
+    to ``"default"`` — the same convention the journal uses."""
+    out: dict[str, dict[str, Any]] = {}
+    for rec in records:
+        t = rec.get("tenant", "default")
+        ent = out.setdefault(t, {
+            "requests": 0, "prompt_tokens": 0, "new_tokens": 0,
+            "reasons": defaultdict(int),
+            "kv_bytes_read": 0.0, "kv_bytes_written": 0.0,
+            "weight_bytes_amortized": 0.0, "device_time_s": 0.0,
+        })
+        ent["requests"] += 1
+        ent["prompt_tokens"] += int(rec.get("prompt_tokens", 0))
+        ent["new_tokens"] += int(rec.get("new_tokens", 0))
+        ent["reasons"][rec.get("reason", "?")] += 1
+        cost = rec.get("cost") or {}
+        for k in ("kv_bytes_read", "kv_bytes_written",
+                  "weight_bytes_amortized", "device_time_s"):
+            ent[k] += float(cost.get(k, 0.0))
+    total_cost = sum(
+        e["kv_bytes_read"] + e["kv_bytes_written"]
+        + e["weight_bytes_amortized"] for e in out.values()
+    )
+    for ent in out.values():
+        mine = (ent["kv_bytes_read"] + ent["kv_bytes_written"]
+                + ent["weight_bytes_amortized"])
+        ent["cost_share"] = mine / total_cost if total_cost else 0.0
+        ent["reasons"] = dict(ent["reasons"])
+    return out
+
+
+def format_tenants(records: list[dict]) -> str:
+    """The per-tenant breakdown table, worst-billed tenant first."""
+    table = tenant_table(records)
+    lines = [f"== tenants: {len(table)} from {len(records)} "
+             f"request-log lines =="]
+    lines.append(
+        f"{'tenant':<16} {'reqs':>5} {'prompt':>7} {'new':>6} "
+        f"{'dev_MiB':>8} {'dev_ms':>7} {'share':>6} reasons"
+    )
+    by_cost = sorted(
+        table.items(), key=lambda kv: (-kv[1]["cost_share"], kv[0])
+    )
+    for tenant, ent in by_cost:
+        dev_bytes = (ent["kv_bytes_read"] + ent["kv_bytes_written"]
+                     + ent["weight_bytes_amortized"])
+        reasons = ",".join(
+            f"{r}={n}" for r, n in sorted(ent["reasons"].items())
+        )
+        lines.append(
+            f"{tenant:<16} {ent['requests']:>5} "
+            f"{ent['prompt_tokens']:>7} {ent['new_tokens']:>6} "
+            f"{dev_bytes / 2**20:>8.2f} "
+            f"{ent['device_time_s'] * 1e3:>7.2f} "
+            f"{ent['cost_share']:>6.1%} {reasons}"
+        )
+    return "\n".join(lines)
+
+
 def slowest_ticks(events: list[dict], k: int) -> list[dict]:
     ticks = [e for e in events
              if e.get("ph") == "X" and e.get("cat") == "tick"]
@@ -476,6 +561,10 @@ def main(argv: list[str] | None = None) -> str:
     p.add_argument("--merge", default=None, metavar="OUT",
                    help="write the merged/rebased trace JSON to OUT "
                    "(implied merge mode; open at ui.perfetto.dev)")
+    p.add_argument("--request-log", default=None, metavar="PATH",
+                   help="canonical request log (--request-log JSONL) "
+                   "for the same run: adds the per-tenant request/"
+                   "token/cost breakdown section")
     args = p.parse_args(argv)
     if args.merge is not None or len(args.trace) > 1:
         merged = merge_traces(args.trace)
@@ -487,6 +576,8 @@ def main(argv: list[str] | None = None) -> str:
                     f"events to {args.merge}")
     else:
         out = format_summary(load_trace(args.trace[0]), top=args.top)
+    if args.request_log is not None:
+        out += "\n" + format_tenants(load_request_log(args.request_log))
     print(out)
     return out
 
